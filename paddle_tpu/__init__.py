@@ -1,0 +1,58 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (Fluid era).
+
+This is not a port: the user-facing Program/Block/Operator IR matches
+fluid (so reference model scripts run with an import change), but execution
+is jit-compiled whole-block XLA (one HLO per block, donated device state),
+autodiff is functional (jax.value_and_grad) rather than grad-op weaving, and
+distribution is mesh/sharding-based rather than pserver/NCCL.  See
+SURVEY.md for the capability map.
+
+Typical use (parity with `import paddle.v2.fluid as fluid`):
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name='x', shape=[13])
+    y_ = fluid.layers.fc(input=x, size=1)
+    ...
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+"""
+from . import core
+from .core import (Block, CPUPlace, CUDAPlace, LoDTensor, Operator,  # noqa
+                   Parameter, Program, Scope, TPUPlace, Variable, XLAPlace,
+                   create_lod_tensor, default_main_program,
+                   default_startup_program, global_scope, grad_var_name,
+                   name_scope, program_guard, scope_guard,
+                   switch_main_program, switch_startup_program, unique_name)
+from .core.executor import Executor
+from .core import backward
+from .core.backward import append_backward, calc_gradient  # noqa: F401
+
+from . import ops  # registers the op library  # noqa: F401
+from . import layers
+from . import initializer
+from . import learning_rate_decay
+from . import nets
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import evaluator
+from . import io
+from .data_feeder import DataFeeder
+from .param_attr import ParamAttr
+from . import profiler
+
+Tensor = LoDTensor
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'core', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
+    'regularizer', 'learning_rate_decay', 'clip', 'evaluator', 'io',
+    'profiler',
+    'Executor', 'Program', 'Block', 'Operator', 'Variable', 'Parameter',
+    'Scope', 'LoDTensor', 'Tensor', 'ParamAttr', 'DataFeeder',
+    'CPUPlace', 'CUDAPlace', 'TPUPlace', 'XLAPlace',
+    'default_main_program', 'default_startup_program', 'program_guard',
+    'scope_guard', 'global_scope', 'append_backward', 'unique_name',
+]

@@ -1,0 +1,142 @@
+"""Gradient and error clipping.
+
+Reference parity: python/paddle/v2/fluid/clip.py (GradientClipByValue,
+ByNorm, ByGlobalNorm, ErrorClipByValue).
+"""
+import functools
+
+from .core.program import grad_var_name
+
+__all__ = [
+    'BaseErrorClipAttr', 'ErrorClipByValue', 'error_clip_callback',
+    'BaseGradientClipAttr', 'NullGradientClipAttr', 'GradientClipByValue',
+    'GradientClipByNorm', 'GradientClipByGlobalNorm',
+    'append_gradient_clip_ops', 'set_gradient_clip',
+]
+
+
+class BaseErrorClipAttr(object):
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        min = float(min) if min is not None else -max
+        self.max = max
+        self.min = min
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(
+            type='clip',
+            inputs={'X': [grad_name]},
+            outputs={'Out': [grad_name]},
+            attrs={'min': self.min, 'max': self.max})
+
+
+def error_clip_callback(block, context):
+    for var_name, var in list(block.vars.items()):
+        error_clip = getattr(var, 'error_clip', None)
+        if error_clip is not None:
+            error_clip.append_clip_op(block, grad_var_name(var_name))
+
+
+class BaseGradientClipAttr(object):
+    def process_context(self, context, param, grad):
+        pass
+
+    def create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        min = float(min) if min is not None else -max
+        self.max = max
+        self.min = min
+
+    def create_operators(self, param, grad):
+        from .layers import ops as layer_ops
+        new_grad = layer_ops.clip(x=grad, min=self.min, max=self.max)
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def create_operators(self, param, grad):
+        from .layers import ops as layer_ops
+        new_grad = layer_ops.clip_by_norm(x=grad, max_norm=self.clip_norm)
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+        self.context = None
+
+    def process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        from .layers import nn as layer_nn
+        sq = layer_nn.reduce_sum(
+            input=_square(grad))
+        context[self.group_name].append(sq)
+        self.context = context
+
+    def create_operators(self, param, grad):
+        from .layers import nn as layer_nn
+        from .layers import ops as layer_ops
+        from .layers import tensor as layer_tensor
+        group_scale_name = self.group_name + "_scale"
+        if group_scale_name not in self.context:
+            group_norm = layer_tensor.sums(self.context[self.group_name])
+            group_norm = layer_ops.sqrt(x=group_norm)
+            clip_var = layer_tensor.fill_constant(
+                shape=[1], dtype='float32', value=self.clip_norm)
+            scale = layer_ops.elementwise_div(
+                x=clip_var,
+                y=layer_ops.elementwise_max(x=clip_var, y=group_norm))
+            self.context[group_scale_name] = scale
+        new_grad = layer_ops.elementwise_mul(
+            x=grad, y=self.context[group_scale_name])
+        return param, new_grad
+
+
+def _square(v):
+    from .layers import ops as layer_ops
+    return layer_ops.square(x=v)
+
+
+_gradient_clip_attr = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _gradient_clip_attr
+    if param_list:
+        for p in param_list:
+            p.gradient_clip_attr = clip
+    else:
+        _gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grad):
+    context = {}
+    create_op_callbacks = []
+    for p, g in param_grad:
+        clip_attr = getattr(p, 'gradient_clip_attr', None) or \
+            _gradient_clip_attr or NullGradientClipAttr()
+        clip_attr.process_context(context=context, param=p, grad=g)
+        create_op_callbacks.append(
+            functools.partial(clip_attr.create_operators, param=p, grad=g))
+    return [each_callback() for each_callback in create_op_callbacks]

@@ -1,0 +1,12 @@
+"""Core IR + runtime (parity with paddle/framework; see SURVEY.md §2.1)."""
+from .datatypes import convert_dtype  # noqa: F401
+from .lod import LoDTensor, create_lod_tensor  # noqa: F401
+from .place import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401
+                    XLAPlace, default_place)
+from .program import (Block, Operator, Parameter, Program,  # noqa: F401
+                      Variable, default_main_program,
+                      default_startup_program, grad_var_name, name_scope,
+                      program_guard, switch_main_program,
+                      switch_startup_program, unique_name)
+from .registry import register_op, registered_ops  # noqa: F401
+from .scope import Scope, global_scope, scope_guard  # noqa: F401

@@ -1,0 +1,98 @@
+"""Functional autodiff over a program block.
+
+Reference parity: paddle/framework/backward.cc and fluid/backward.py — the
+reference weaves one hand-written grad op per forward op into the block.
+TPU-native design: we append a single `autodiff` op whose interpretation is
+`jax.value_and_grad` over the forward op range (core/executor.py
+_run_autodiff).  XLA sees one differentiated computation and fuses
+forward+backward; there are no per-op grad kernels to maintain.
+"""
+from .program import Parameter, Variable, default_main_program, grad_var_name
+
+__all__ = ['append_backward', 'calc_gradient']
+
+
+def _collect_trainable_params(block, loss, parameter_list=None,
+                              no_grad_set=None):
+    no_grad = set(no_grad_set or [])
+    if parameter_list is not None:
+        names = [p.name if isinstance(p, Variable) else p
+                 for p in parameter_list]
+    else:
+        params = block.program.all_parameters()
+        names = [p.name for p in params
+                 if getattr(p, 'trainable', True)]
+    return [n for n in names if n not in no_grad]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append an `autodiff` op producing `<param>@GRAD` for every trainable
+    parameter, and return [(param, grad_var)] like fluid's append_backward.
+    """
+    assert isinstance(loss, Variable)
+    program = loss.block.program
+    block = program.global_block()
+    param_names = _collect_trainable_params(block, loss, parameter_list,
+                                            no_grad_set)
+
+    grad_names = [grad_var_name(n) for n in param_names]
+    params_and_grads = []
+    for pn, gn in zip(param_names, grad_names):
+        p = block.var(pn)
+        if not block.has_var(gn):
+            g = block.create_var(name=gn, shape=p.shape, dtype=p.dtype,
+                                 persistable=False)
+            g.stop_gradient = True
+        else:
+            g = block.var(gn)
+        params_and_grads.append((p, g))
+
+    fwd_end = len(block.ops)
+    block.append_op(
+        type='autodiff',
+        inputs={'Loss': [loss]},
+        outputs={'Grads': grad_names},
+        attrs={
+            'forward_start': 0,
+            'forward_end': fwd_end,
+            'loss_name': loss.name,
+            'param_names': param_names,
+            'grad_names': grad_names,
+            'loss_scale': 1.0,
+        })
+    return params_and_grads
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Gradient of `targets` w.r.t. arbitrary `inputs` (not only
+    Parameters).  Parity with fluid.backward.calc_gradient."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert len(targets) == 1, "calc_gradient supports a single target"
+    loss = targets[0]
+    block = loss.block.program.global_block()
+    in_names = [v.name if isinstance(v, Variable) else v for v in inputs]
+    grad_names = [grad_var_name(n) for n in in_names]
+    grads = []
+    for n, gn in zip(in_names, grad_names):
+        v = block.var(n)
+        if not block.has_var(gn):
+            g = block.create_var(name=gn, shape=v.shape, dtype=v.dtype)
+            g.stop_gradient = True
+        else:
+            g = block.var(gn)
+        grads.append(g)
+    block.append_op(
+        type='autodiff',
+        inputs={'Loss': [loss]},
+        outputs={'Grads': grad_names},
+        attrs={
+            'forward_start': 0,
+            'forward_end': len(block.ops),
+            'loss_name': loss.name,
+            'param_names': in_names,
+            'grad_names': grad_names,
+            'loss_scale': 1.0,
+        })
+    return grads

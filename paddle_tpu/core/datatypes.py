@@ -1,0 +1,65 @@
+"""Dtype registry.
+
+Reference parity: paddle/framework/data_type.h and
+python/paddle/v2/fluid/data_feeder.py dtype strings.  TPU-native addition:
+bfloat16 is a first-class dtype (the MXU native matmul type).
+"""
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    bfloat16 = ml_dtypes.bfloat16
+except Exception:  # pragma: no cover
+    bfloat16 = np.float32
+
+_STR2NP = {
+    'float16': np.float16,
+    'bfloat16': bfloat16,
+    'float32': np.float32,
+    'float64': np.float64,
+    'int8': np.int8,
+    'uint8': np.uint8,
+    'int16': np.int16,
+    'int32': np.int32,
+    'int64': np.int64,
+    'bool': np.bool_,
+}
+
+_ALIASES = {
+    'float': 'float32',
+    'double': 'float64',
+    'int': 'int32',
+    'fp16': 'float16',
+    'bf16': 'bfloat16',
+    'fp32': 'float32',
+    'fp64': 'float64',
+}
+
+
+def convert_dtype(dtype):
+    """Normalise a dtype spec (string / numpy dtype / jax dtype) to a
+    canonical string name."""
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _STR2NP:
+            raise ValueError("unsupported dtype: %r" % (dtype,))
+        return name
+    name = np.dtype(dtype).name
+    if name == 'bfloat16' or 'bfloat16' in str(dtype):
+        return 'bfloat16'
+    return convert_dtype(name)
+
+
+def as_numpy_dtype(dtype):
+    return _STR2NP[convert_dtype(dtype)]
+
+
+def is_float_dtype(dtype):
+    return convert_dtype(dtype) in ('float16', 'bfloat16', 'float32',
+                                    'float64')
+
+
+def is_integer_dtype(dtype):
+    return convert_dtype(dtype) in ('int8', 'uint8', 'int16', 'int32',
+                                    'int64')

@@ -1,0 +1,76 @@
+"""Build-time shape/dtype inference.
+
+Reference parity: paddle/framework/shape_inference.h + each op's InferShape.
+TPU-native twist: there is ONE source of truth — the op's jax compute
+function — abstractly evaluated with jax.eval_shape.  The unknown batch
+dimension (-1) is substituted with a sentinel prime and mapped back in the
+result, so layers never duplicate shape logic.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import datatypes
+from .registry import get_op_impl
+
+_BATCH_SENTINEL = 509  # prime, unlikely to collide with real dims
+
+
+class _InferCtx(object):
+    """Stand-in ExecutionContext for abstract evaluation."""
+
+    def __init__(self):
+        self.op_index = 0
+        self.block = None
+
+    def rng(self, extra=0):
+        return jax.random.PRNGKey(0)
+
+
+def infer_outputs(op_type, input_specs, attrs, out_slots):
+    """input_specs: {slot: [(shape, dtype) or None]}.  Returns
+    {slot: [(shape, dtype)]} with -1 restored where the sentinel appears.
+    """
+    impl = get_op_impl(op_type)
+    had_unknown = False
+    ins = {}
+    for slot, specs in input_specs.items():
+        vals = []
+        for spec in specs:
+            if spec is None:
+                vals.append(None)
+                continue
+            shape, dtype = spec
+            shape2 = []
+            for d in shape:
+                if d == -1:
+                    had_unknown = True
+                    shape2.append(_BATCH_SENTINEL)
+                else:
+                    shape2.append(int(d))
+            np_dtype = datatypes.as_numpy_dtype(dtype)
+            if np_dtype == np.int64:
+                np_dtype = np.int32
+            elif np_dtype == np.float64:
+                np_dtype = np.float32
+            vals.append(jax.ShapeDtypeStruct(tuple(shape2), np_dtype))
+        ins[slot] = vals
+
+    ctx = _InferCtx()
+
+    def f(ins_):
+        return impl.compute(ctx, ins_, attrs)
+
+    outs = jax.eval_shape(f, ins)
+    result = {}
+    for slot in out_slots:
+        specs = []
+        for o in (outs or {}).get(slot, []):
+            if o is None:
+                specs.append(None)
+                continue
+            shape = tuple(-1 if (had_unknown and d == _BATCH_SENTINEL) else d
+                          for d in o.shape)
+            specs.append((shape, datatypes.convert_dtype(o.dtype)))
+        result[slot] = specs
+    return result

@@ -1,0 +1,65 @@
+"""Device places.
+
+Reference parity: paddle/platform/place.h (CPUPlace / CUDAPlace).  The
+TPU-native framework adds TPUPlace; every place resolves to a jax.Device.
+"""
+import jax
+
+
+class Place(object):
+    _platform = None
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device, falling back to the default
+        backend when the requested platform is absent (e.g. asking for
+        TPUPlace on a CPU-only host during tests)."""
+        if self._platform is not None:
+            try:
+                devs = jax.devices(self._platform)
+            except RuntimeError:
+                devs = jax.devices()
+        else:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    _platform = 'cpu'
+
+    def __init__(self):
+        super(CPUPlace, self).__init__(0)
+
+
+class TPUPlace(Place):
+    """A single TPU chip.  Parity with the reference's CUDAPlace(id)."""
+    _platform = 'tpu'
+
+
+# CUDAPlace is accepted as an alias so reference scripts run unchanged: on a
+# TPU host it resolves to the TPU chip with the same ordinal.
+class CUDAPlace(TPUPlace):
+    pass
+
+
+class XLAPlace(Place):
+    """Whatever jax's default backend is (tpu > gpu > cpu)."""
+    _platform = None
+
+
+def default_place():
+    platform = jax.default_backend()
+    if platform == 'cpu':
+        return CPUPlace()
+    return XLAPlace(0)

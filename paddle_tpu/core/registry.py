@@ -1,0 +1,47 @@
+"""Op registry.
+
+Reference parity: paddle/framework/op_registry.h.  Each op type maps to a
+single pure-jax compute function (instead of per-device kernel families —
+XLA owns device lowering).  Signature:
+
+    def compute(ctx, ins, attrs) -> {slot: [jax.Array, ...]}
+
+where `ins` is {slot: [arrays]} and ctx is an ExecutionContext giving access
+to PRNG keys and the interpreter (for ops with sub-blocks).
+"""
+
+_OP_REGISTRY = {}
+
+
+class OpImpl(object):
+    def __init__(self, type, compute, stateful_rng=False):
+        self.type = type
+        self.compute = compute
+        # ops that consume PRNG (dropout, *_random) — executor threads keys
+        self.stateful_rng = stateful_rng
+
+
+def register_op(type, stateful_rng=False):
+    def deco(fn):
+        if type in _OP_REGISTRY:
+            raise ValueError("op %r already registered" % type)
+        _OP_REGISTRY[type] = OpImpl(type, fn, stateful_rng)
+        return fn
+
+    return deco
+
+
+def get_op_impl(type):
+    impl = _OP_REGISTRY.get(type)
+    if impl is None:
+        raise NotImplementedError(
+            "no TPU implementation registered for op %r" % type)
+    return impl
+
+
+def has_op(type):
+    return type in _OP_REGISTRY
+
+
+def registered_ops():
+    return sorted(_OP_REGISTRY)

@@ -1,0 +1,86 @@
+"""Scope: name -> device array store for persistable state.
+
+Reference parity: paddle/framework/scope.{h,cc}.  Values are jax.Arrays that
+stay resident on device between Executor.run calls (parameters, optimizer
+moments, batch-norm running stats, global step, RNG state).
+"""
+import numpy as np
+
+
+class Scope(object):
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self._kids = []
+        if parent is not None:
+            parent._kids.append(self)
+
+    def var(self, name):
+        """Create-or-get (parity with Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars and s._vars[name] is not None:
+                return True
+            s = s.parent
+        return False
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name):
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError("variable %r has no value in scope (did you run "
+                           "the startup program?)" % name)
+        return v
+
+    def get_numpy(self, name):
+        return np.asarray(self.get(name))
+
+    def new_scope(self):
+        return Scope(self)
+
+    def drop_kids(self):
+        self._kids = []
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return _guard()

@@ -1,0 +1,91 @@
+"""DataFeeder — converts python reader minibatches into feed dicts.
+
+Reference parity: python/paddle/v2/fluid/data_feeder.py.  Ragged (lod_level
+> 0) slots are padded to a rectangle and paired with an int32 lengths vector
+(the TPU-native LoD representation, core/lod.py).
+"""
+import numpy as np
+
+from .core import datatypes
+from .core.lod import LoDTensor
+from .core.program import Variable, default_main_program
+
+__all__ = ['DataFeeder']
+
+
+class DataToLoDTensorConverter(object):
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [d for d in shape]
+        self.dtype = datatypes.as_numpy_dtype(dtype)
+        if self.dtype == np.int64:
+            self.dtype = np.int32
+        elif self.dtype == np.float64:
+            self.dtype = np.float32
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(data)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            shape = [d for d in self.shape if d != -1]
+            if arr.ndim == 2 and len(shape) >= 1 and \
+                    arr.shape[1] == int(np.prod(shape)):
+                arr = arr.reshape([arr.shape[0]] + [int(s) for s in shape])
+            return arr
+        # one LoD level: each row is a sequence
+        seqs = [np.asarray(s, dtype=self.dtype) for s in self.data]
+        return self._ragged(seqs)
+
+    def _ragged(self, seqs):
+        lengths = [len(s) for s in seqs]
+        maxlen = max(lengths) if lengths else 0
+        trailing = seqs[0].shape[1:] if seqs and seqs[0].ndim > 1 else ()
+        out = np.zeros((len(seqs), maxlen) + tuple(trailing),
+                       dtype=self.dtype)
+        for i, s in enumerate(seqs):
+            out[i, :len(s)] = s
+        return (out, np.asarray(lengths, dtype=np.int32))
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("Feed list should contain Variables")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            shape = list(each_var.shape)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(
+                place=self.place, lod_level=lod, shape=shape, dtype=dtype)
+            for lod, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes)
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "The number of fields in data (%d) does not match the "
+                "number of feed vars (%d)" %
+                (len(each_sample), len(converters)))
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        ret_dict = {}
+        for each_name, each_converter in zip(self.feed_names, converters):
+            ret_dict[each_name] = each_converter.done()
+        return ret_dict

@@ -1,0 +1,125 @@
+"""Evaluators — accumulate metrics across minibatches.
+
+Reference parity: python/paddle/v2/fluid/evaluator.py (Accuracy,
+ChunkEvaluator).  States are persistable vars updated in-graph; eval() reads
+them out of the scope.
+"""
+import numpy as np
+
+from . import layers
+from .core.program import Program, Variable, unique_name
+from .initializer import ConstantInitializer
+from .layers.layer_helper import LayerHelper
+
+__all__ = ['Accuracy', 'ChunkEvaluator', 'Evaluator']
+
+
+def _clone_var_(block, var):
+    return block.create_var(
+        name=var.name, shape=var.shape, dtype=var.dtype,
+        persistable=True)
+
+
+class Evaluator(object):
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        from .core.program import program_guard
+        with program_guard(reset_program):
+            for var in self.states:
+                g_var = _clone_var_(reset_program.current_block(), var)
+                layers.fill_constant(
+                    shape=g_var.shape, value=0.0, dtype=g_var.dtype,
+                    out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def create_state(self, suffix, dtype, shape):
+        state = self.helper.create_global_variable(
+            name=unique_name(self.helper.name + "_" + suffix),
+            persistable=True, dtype=dtype, shape=shape)
+        self.helper.set_variable_initializer(state, ConstantInitializer(0.0))
+        self.states.append(state)
+        return state
+
+
+class Accuracy(Evaluator):
+    """Streaming top-k accuracy."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super(Accuracy, self).__init__("accuracy", **kwargs)
+        total = self.create_state(dtype='float32', shape=[1],
+                                  suffix='total')
+        correct = self.create_state(dtype='float32', shape=[1],
+                                    suffix='correct')
+        batch_correct = self.helper.create_tmp_variable('int32',
+                                                        stop_gradient=True)
+        batch_total = self.helper.create_tmp_variable('int32',
+                                                      stop_gradient=True)
+        acc = layers.accuracy(input=input, label=label, k=k,
+                              correct=batch_correct, total=batch_total)
+        bc_f = layers.cast(batch_correct, 'float32')
+        bt_f = layers.cast(batch_total, 'float32')
+        layers.sums(input=[total, bt_f], out=total)
+        layers.sums(input=[correct, bc_f], out=correct)
+        self.metrics.append(acc)
+        self._total = total
+        self._correct = correct
+
+    def eval(self, executor, eval_program=None):
+        scope = executor  # allow passing executor; read from global scope
+        from .core.scope import global_scope
+        total = float(global_scope().get_numpy(self._total.name)[0])
+        correct = float(global_scope().get_numpy(self._correct.name)[0])
+        return np.array([correct / max(total, 1.0)], dtype=np.float32)
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (parity with fluid ChunkEvaluator; counts come
+    from the chunk_eval op)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, **kwargs):
+        super(ChunkEvaluator, self).__init__("chunk_eval", **kwargs)
+        main_program = self.helper.main_program
+        num_infer_chunks = self.create_state(
+            dtype='float32', shape=[1], suffix='num_infer_chunks')
+        num_label_chunks = self.create_state(
+            dtype='float32', shape=[1], suffix='num_label_chunks')
+        num_correct_chunks = self.create_state(
+            dtype='float32', shape=[1], suffix='num_correct_chunks')
+        precision, recall, f1, infer_cnt, label_cnt, correct_cnt = \
+            layers.chunk_eval(
+                input=input, label=label, chunk_scheme=chunk_scheme,
+                num_chunk_types=num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types)
+        layers.sums(input=[num_infer_chunks,
+                           layers.cast(infer_cnt, 'float32')],
+                    out=num_infer_chunks)
+        layers.sums(input=[num_label_chunks,
+                           layers.cast(label_cnt, 'float32')],
+                    out=num_label_chunks)
+        layers.sums(input=[num_correct_chunks,
+                           layers.cast(correct_cnt, 'float32')],
+                    out=num_correct_chunks)
+        self.metrics.extend([precision, recall, f1])
+        self._states = (num_infer_chunks, num_label_chunks,
+                        num_correct_chunks)
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+        infer = float(global_scope().get_numpy(self._states[0].name)[0])
+        label = float(global_scope().get_numpy(self._states[1].name)[0])
+        correct = float(global_scope().get_numpy(self._states[2].name)[0])
+        precision = correct / infer if infer else 0.0
+        recall = correct / label if label else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if precision + recall else 0.0
+        return np.array([precision, recall, f1], dtype=np.float32)

@@ -1,0 +1,161 @@
+"""Model save/load and inference-model serialization.
+
+Reference parity: python/paddle/v2/fluid/io.py.  Variables serialize as .npy
+files (one per var, like the reference's one-file-per-var layout); the
+inference program serializes as JSON (core/program.py), playing the role of
+the reference's ProgramDesc protobuf `__model__` file.
+"""
+import os
+
+import numpy as np
+
+from .core.program import Parameter, Program, Variable, default_main_program
+from .core.scope import global_scope
+
+__all__ = [
+    'save_vars', 'save_params', 'save_persistables', 'load_vars',
+    'load_params', 'load_persistables', 'save_inference_model',
+    'load_inference_model', 'get_inference_program',
+    'get_parameter_value', 'get_parameter_value_by_name', 'is_parameter',
+    'is_persistable', 'save_checkpoint', 'load_checkpoint',
+]
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None):
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    for var in vars:
+        name = var.name if isinstance(var, Variable) else var
+        value = scope.find_var(name)
+        if value is None:
+            continue
+        np.save(os.path.join(dirname, _safe(name) + '.npy'),
+                np.asarray(value))
+
+
+def save_params(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program, vars=None,
+              predicate=is_parameter)
+
+
+def save_persistables(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program, vars=None,
+              predicate=is_persistable)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None):
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    scope = global_scope()
+    for var in vars:
+        name = var.name if isinstance(var, Variable) else var
+        path = os.path.join(dirname, _safe(name) + '.npy')
+        if os.path.exists(path):
+            scope.set(name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter)
+
+
+def load_persistables(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable)
+
+
+def load_persistables_if_exist(executor, dirname, main_program=None):
+    if os.path.isdir(dirname):
+        load_persistables(executor, dirname, main_program)
+
+
+def _safe(name):
+    return name.replace('/', '%2F')
+
+
+def get_inference_program(target_vars, main_program=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program.prune(targets=target_vars)
+    return pruned.inference_optimize()
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None):
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    if main_program is None:
+        main_program = default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program.prune(targets=target_vars,
+                                feeds=feeded_var_names)
+    inference_program = pruned.inference_optimize()
+    fetch_var_names = [v.name for v in target_vars]
+    meta = dict(program=inference_program.to_dict(),
+                feed_var_names=list(feeded_var_names),
+                fetch_var_names=fetch_var_names)
+    import json
+    with open(os.path.join(dirname, '__model__'), 'w') as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, inference_program)
+    return inference_program
+
+
+def load_inference_model(dirname, executor):
+    import json
+    with open(os.path.join(dirname, '__model__')) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta['program'])
+    load_persistables(executor, dirname, program)
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta['fetch_var_names']]
+    return program, meta['feed_var_names'], fetch_vars
+
+
+def get_parameter_value(para, executor=None):
+    assert is_parameter(para)
+    return global_scope().get_numpy(para.name)
+
+
+def get_parameter_value_by_name(name, executor=None, program=None):
+    if program is None:
+        program = default_main_program()
+    var = program.global_block().var(name)
+    return get_parameter_value(var, executor)
+
+
+# -- checkpoint/resume (SURVEY.md A2) ------------------------------------
+def save_checkpoint(executor, dirname, main_program=None, step=None):
+    """Full training state: every persistable (params + optimizer moments +
+    bn stats + counters)."""
+    save_persistables(executor, dirname, main_program)
+    if step is not None:
+        with open(os.path.join(dirname, 'STEP'), 'w') as f:
+            f.write(str(int(step)))
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    load_persistables(executor, dirname, main_program)
+    step_file = os.path.join(dirname, 'STEP')
+    if os.path.exists(step_file):
+        with open(step_file) as f:
+            return int(f.read().strip())
+    return None

@@ -1,0 +1,16 @@
+"""Layer library (parity with python/paddle/v2/fluid/layers)."""
+from .. import ops as _ops  # ensure op registry is populated  # noqa: F401
+
+from . import io, nn, ops, sequence, tensor
+from .io import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+
+__all__ = []
+__all__ += io.__all__
+__all__ += nn.__all__
+__all__ += ops.__all__
+__all__ += sequence.__all__
+__all__ += tensor.__all__

@@ -1,0 +1,46 @@
+"""IO layers (data declaration).
+
+Reference parity: python/paddle/v2/fluid/layers/io.py.
+"""
+from ..core.program import LEN_SUFFIX
+from .layer_helper import LayerHelper
+
+__all__ = ['data']
+
+
+def data(name,
+         shape,
+         append_batch_size=True,
+         dtype='float32',
+         lod_level=0,
+         type=None,
+         stop_gradient=True,
+         **kwargs):
+    """Declare a feed variable.  With lod_level>0 a companion `<name>@LEN`
+    int32 vector is declared too — the TPU-native ragged representation
+    (see core/lod.py)."""
+    helper = LayerHelper('data', **locals())
+    shape = list(shape)
+    if lod_level > 0:
+        # fluid declares the per-timestep shape of the flat [sum_T, ...]
+        # LoD layout; the TPU padded layout is [batch, time, ...].  A
+        # trailing per-step shape of [1] (token ids) maps to [B, T].
+        inner = [d for d in shape]
+        if inner and inner[-1] == 1 and len(inner) == 1:
+            inner = []
+        shape = [-1, -1] + inner
+    elif append_batch_size:
+        shape = [-1] + shape
+    block = helper.main_program.current_block()
+    if block.has_var(name):
+        return block.var(name)
+    var = block.create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        persistable=False, is_data=True)
+    var.stop_gradient = stop_gradient
+    if lod_level > 0:
+        lv = block.create_var(
+            name=name + LEN_SUFFIX, shape=[-1], dtype='int32', lod_level=0,
+            persistable=False, is_data=True)
+        lv.stop_gradient = True
+    return var

@@ -1,0 +1,189 @@
+"""Tensor layers.
+
+Reference parity: python/paddle/v2/fluid/layers/tensor.py.
+"""
+from ..core.program import Variable
+from .layer_helper import LayerHelper
+
+__all__ = [
+    'create_tensor', 'create_parameter', 'create_global_var', 'cast',
+    'concat', 'sums', 'assign', 'fill_constant',
+    'fill_constant_batch_size_like', 'ones', 'zeros', 'reshape',
+    'transpose', 'expand', 'argmax_like_topk',
+]
+
+
+def create_tensor(dtype, name=None, persistable=False, **kwargs):
+    helper = LayerHelper('create_tensor', **locals())
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, attr=None, is_bias=False,
+                     default_initializer=None, **kwargs):
+    helper = LayerHelper('create_parameter', **locals())
+    from ..param_attr import ParamAttr
+    return helper.create_parameter(ParamAttr.to_attr(attr), shape, dtype,
+                                   is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, name=None,
+                      **kwargs):
+    helper = LayerHelper('global_var', **locals())
+    var = helper.create_global_variable(name=name, persistable=persistable,
+                                        shape=shape, dtype=dtype)
+    from ..initializer import ConstantInitializer
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def cast(x, dtype, **kwargs):
+    helper = LayerHelper('cast', **locals())
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type='cast',
+                     inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'in_dtype': x.dtype, 'out_dtype': dtype})
+    return out
+
+
+def concat(input, axis=0, **kwargs):
+    helper = LayerHelper('concat', **locals())
+    out = helper.create_tmp_variable(helper.input_dtype())
+    helper.append_op(type='concat',
+                     inputs={'X': input},
+                     outputs={'Out': [out]},
+                     attrs={'axis': axis})
+    return out
+
+
+def sums(input, out=None, **kwargs):
+    helper = LayerHelper('sum', **locals())
+    if out is None:
+        out = helper.create_tmp_variable(helper.input_dtype())
+    helper.append_op(type='sum', inputs={'X': input},
+                     outputs={'Out': [out]})
+    return out
+
+
+def assign(input, output=None, **kwargs):
+    helper = LayerHelper('assign', **locals())
+    if output is None:
+        output = helper.create_tmp_variable(
+            input.dtype if isinstance(input, Variable) else 'float32')
+    if isinstance(input, Variable):
+        helper.append_op(type='assign', inputs={'X': [input]},
+                         outputs={'Out': [output]})
+    else:
+        import numpy as np
+        arr = np.asarray(input)
+        helper.append_op(
+            type='assign_value',
+            outputs={'Out': [output]},
+            attrs={'shape': list(arr.shape), 'dtype': str(arr.dtype),
+                   'values': arr.flatten().tolist()})
+    return output
+
+
+def fill_constant(shape, dtype, value, out=None, **kwargs):
+    helper = LayerHelper('fill_constant', **locals())
+    if out is None:
+        out = helper.create_tmp_variable(dtype)
+    helper.append_op(type='fill_constant',
+                     outputs={'Out': [out]},
+                     attrs={'shape': [int(s) for s in shape],
+                            'dtype': dtype, 'value': float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  **kwargs):
+    helper = LayerHelper('fill_constant_batch_size_like', **locals())
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type='fill_constant_batch_size_like',
+                     inputs={'Input': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'shape': [int(s) for s in shape],
+                            'dtype': dtype, 'value': float(value),
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx})
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, **kwargs):
+    return fill_constant(value=1.0, shape=shape, dtype=dtype)
+
+
+def zeros(shape, dtype, **kwargs):
+    return fill_constant(value=0.0, shape=shape, dtype=dtype)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, **kwargs):
+    helper = LayerHelper('reshape', **locals())
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type='reshape', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'shape': [int(s) for s in shape]})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, **kwargs):
+    helper = LayerHelper('transpose', **locals())
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type='transpose', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'axis': [int(p) for p in perm]})
+    return out
+
+
+def expand(x, expand_times, **kwargs):
+    helper = LayerHelper('expand', **locals())
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type='expand', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'expand_times': [int(t) for t in expand_times]})
+    return out
+
+
+def argmax_like_topk(x, **kwargs):
+    from .nn import topk
+    return topk(x, 1)[1]
+
+
+def select(condition, x, y, **kwargs):
+    helper = LayerHelper('select', **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type='select',
+        inputs={'Condition': [condition], 'X': [x], 'Y': [y]},
+        outputs={'Out': [out]})
+    return out
+
+
+def less_than(x, y, cond=None, **kwargs):
+    helper = LayerHelper('less_than', **kwargs)
+    if cond is None:
+        cond = helper.create_tmp_variable('bool', stop_gradient=True)
+    helper.append_op(type='less_than', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def equal(x, y, cond=None, **kwargs):
+    helper = LayerHelper('equal', **kwargs)
+    if cond is None:
+        cond = helper.create_tmp_variable('bool', stop_gradient=True)
+    helper.append_op(type='equal', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def array_to_lod_tensor(*args, **kwargs):
+    raise NotImplementedError(
+        "tensor-array ops arrive with control-flow support")
+
+
+__all__ += ['select', 'less_than', 'equal']

@@ -1,0 +1,104 @@
+"""Learning-rate decay schedules.
+
+Reference parity: python/paddle/v2/fluid/learning_rate_decay.py
+(exponential_decay, natural_exp_decay, inverse_time_decay, polynomial_decay,
+piecewise_decay) — each builds ops computing the LR from a step counter, so
+the schedule runs inside the same compiled step as the update ops.
+"""
+from . import layers
+from .core.program import unique_name
+from .initializer import ConstantInitializer
+from .layers.layer_helper import LayerHelper
+
+__all__ = [
+    'exponential_decay', 'natural_exp_decay', 'inverse_time_decay',
+    'polynomial_decay', 'piecewise_decay', 'global_step_counter',
+]
+
+
+def global_step_counter(counter_name=None, begin=0, step=1):
+    """A persistable float32 step counter incremented once per executor run
+    (parity with fluid's autoincreased_step_counter)."""
+    helper = LayerHelper('global_step_counter')
+    name = counter_name or unique_name('@STEP_COUNTER@')
+    counter = helper.create_global_variable(
+        name=name, dtype='float32', shape=[1], persistable=True)
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(float(begin - step)))
+    helper.append_op(
+        type='increment', inputs={'X': [counter]},
+        outputs={'Out': [counter]}, attrs={'step': float(step)},
+        infer_shape=False)
+    counter.stop_gradient = True
+    return counter
+
+
+def _decay_step_counter():
+    return global_step_counter(begin=1)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    global_step = _decay_step_counter()
+    div_res = layers.scale(x=global_step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div_res = layers.floor(x=div_res)
+    base = layers.fill_constant(shape=[1], dtype='float32',
+                                value=float(decay_rate))
+    decay = layers.elementwise_pow(x=base, y=div_res)
+    return layers.scale(x=decay, scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    global_step = _decay_step_counter()
+    div_res = layers.scale(x=global_step, scale=1.0 / decay_steps)
+    if staircase:
+        div_res = layers.floor(x=div_res)
+    exponent = layers.scale(x=div_res, scale=-float(decay_rate))
+    decay = layers.exp(x=exponent)
+    return layers.scale(x=decay, scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    global_step = _decay_step_counter()
+    div_res = layers.scale(x=global_step, scale=1.0 / decay_steps)
+    if staircase:
+        div_res = layers.floor(x=div_res)
+    denom = layers.scale(x=div_res, scale=float(decay_rate), bias=1.0)
+    one = layers.fill_constant(shape=[1], dtype='float32',
+                               value=float(learning_rate))
+    return layers.elementwise_div(x=one, y=denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    global_step = _decay_step_counter()
+    gs = layers.elementwise_min(
+        x=global_step,
+        y=layers.fill_constant(shape=[1], dtype='float32',
+                               value=float(decay_steps)))
+    frac = layers.scale(x=gs, scale=1.0 / float(decay_steps))
+    one_minus = layers.scale(x=frac, scale=-1.0, bias=1.0)
+    powed = layers.pow(x=one_minus, attrs={'factor': float(power)})
+    return layers.scale(x=powed,
+                        scale=float(learning_rate - end_learning_rate),
+                        bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    """LR = values[i] for step in (boundaries[i-1], boundaries[i]]."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    global_step = _decay_step_counter()
+    lr = layers.fill_constant(shape=[1], dtype='float32', value=values[-1])
+    # build nested selection from the last interval back to the first
+    for b, v in reversed(list(zip(boundaries, values[:-1]))):
+        bconst = layers.fill_constant(shape=[1], dtype='float32',
+                                      value=float(b))
+        cond = layers.less_than(x=global_step, y=bconst)
+        vconst = layers.fill_constant(shape=[1], dtype='float32',
+                                      value=float(v))
+        lr = layers.select(cond, vconst, lr)
+    return lr

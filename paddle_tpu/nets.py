@@ -1,0 +1,113 @@
+"""Composite networks.
+
+Reference parity: python/paddle/v2/fluid/nets.py (simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu, scaled_dot_product_attention).
+"""
+from . import layers
+
+__all__ = [
+    'simple_img_conv_pool', 'sequence_conv_pool', 'glu',
+    'scaled_dot_product_attention', 'img_conv_group',
+]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, param_attr=None,
+                         pool_type='max', data_format='NCHW'):
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act, data_format=data_format)
+    pool_out = layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, data_format=data_format)
+    return pool_out
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type='max', data_format='NCHW'):
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _to_list(obj):
+        if isinstance(obj, (list, tuple)):
+            assert len(obj) == len(conv_num_filter)
+            return list(obj)
+        return [obj] * len(conv_num_filter)
+
+    conv_padding = _to_list(conv_padding)
+    conv_filter_size = _to_list(conv_filter_size)
+    param_attr = _to_list(param_attr)
+    conv_with_batchnorm = _to_list(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _to_list(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i], padding=conv_padding[i],
+            param_attr=param_attr[i], act=local_conv_act,
+            data_format=data_format)
+        if conv_with_batchnorm[i]:
+            data_layout = data_format
+            tmp = layers.batch_norm(input=tmp, act=conv_act,
+                                    data_layout=data_layout)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+    pool_out = layers.pool2d(input=tmp, pool_size=pool_size,
+                             pool_type=pool_type, pool_stride=pool_stride,
+                             data_format=data_format)
+    return pool_out
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act='sigmoid', pool_type='max'):
+    conv_out = layers.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act)
+    pool_out = layers.sequence_pool(input=conv_out, pool_type=pool_type)
+    return pool_out
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in half along dim, a * sigmoid(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    act_b = layers.sigmoid(x=b)
+    return layers.elementwise_mul(x=a, y=act_b)
+
+
+def scaled_dot_product_attention(queries, keys, values,
+                                 num_heads=1, dropout_rate=0.0):
+    """Multi-head scaled dot-product attention (fluid/nets.py parity).
+    Inputs are [batch, seq, d]; runs as MXU batched matmuls."""
+    if num_heads < 1:
+        raise ValueError("num_heads must be >= 1")
+    head_dim = queries.shape[-1] // num_heads
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        reshaped = layers.reshape(
+            x=x, shape=[x.shape[0] if x.shape[0] > 0 else -1, x.shape[1],
+                        num_heads, head_dim])
+        return layers.transpose(x=reshaped, perm=[0, 2, 1, 3])
+
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    scaled_q = layers.scale(x=q, scale=head_dim ** -0.5)
+    product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
+    weights = layers.softmax(x=product)
+    if dropout_rate:
+        weights = layers.dropout(x=weights, dropout_prob=dropout_rate)
+    ctx_multiheads = layers.matmul(weights, v)
+    if num_heads == 1:
+        return ctx_multiheads
+    ctx = layers.transpose(ctx_multiheads, perm=[0, 2, 1, 3])
+    return layers.reshape(
+        x=ctx, shape=[ctx.shape[0] if ctx.shape[0] > 0 else -1,
+                      ctx.shape[1], num_heads * head_dim])

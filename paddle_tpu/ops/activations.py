@@ -1,0 +1,77 @@
+"""Activation ops.
+
+Reference parity: paddle/operators/activation_op.{cc,cu,h} — the full list
+in fluid/layers/ops.py __activations__.  All are pure jnp element-wise
+functions; XLA fuses them into the producing matmul/conv on TPU.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _impl(ctx, ins, attrs, _fn=fn):
+        return out(_fn(first(ins, 'X'), attrs))
+
+    return _impl
+
+
+_unary('sigmoid', lambda x, a: jax.nn.sigmoid(x))
+_unary('logsigmoid', lambda x, a: jax.nn.log_sigmoid(x))
+_unary('exp', lambda x, a: jnp.exp(x))
+_unary('relu', lambda x, a: jax.nn.relu(x))
+_unary('tanh', lambda x, a: jnp.tanh(x))
+_unary('tanh_shrink', lambda x, a: x - jnp.tanh(x))
+_unary('sqrt', lambda x, a: jnp.sqrt(x))
+_unary('abs', lambda x, a: jnp.abs(x))
+_unary('ceil', lambda x, a: jnp.ceil(x))
+_unary('floor', lambda x, a: jnp.floor(x))
+_unary('round', lambda x, a: jnp.round(x))
+_unary('reciprocal', lambda x, a: 1.0 / x)
+_unary('log', lambda x, a: jnp.log(x))
+_unary('square', lambda x, a: jnp.square(x))
+_unary('softplus', lambda x, a: jax.nn.softplus(x))
+_unary('softsign', lambda x, a: jax.nn.soft_sign(x))
+_unary('softshrink',
+       lambda x, a: jnp.where(x > a.get('lambda', 0.5), x - a.get('lambda', 0.5),
+                              jnp.where(x < -a.get('lambda', 0.5),
+                                        x + a.get('lambda', 0.5),
+                                        jnp.zeros_like(x))))
+_unary('hard_shrink',
+       lambda x, a: jnp.where(jnp.abs(x) > a.get('threshold', 0.5), x,
+                              jnp.zeros_like(x)))
+_unary('brelu',
+       lambda x, a: jnp.clip(x, a.get('t_min', 0.0), a.get('t_max', 24.0)))
+_unary('leaky_relu',
+       lambda x, a: jnp.where(x >= 0, x, a.get('alpha', 0.02) * x))
+_unary('soft_relu',
+       lambda x, a: jnp.log1p(
+           jnp.exp(jnp.clip(x, -a.get('threshold', 40.0),
+                            a.get('threshold', 40.0)))))
+_unary('elu',
+       lambda x, a: jnp.where(x >= 0, x,
+                              a.get('alpha', 1.0) * (jnp.exp(x) - 1)))
+_unary('relu6', lambda x, a: jnp.clip(x, 0.0, a.get('threshold', 6.0)))
+_unary('pow', lambda x, a: jnp.power(x, a.get('factor', 1.0)))
+_unary('stanh',
+       lambda x, a: a.get('scale_b', 1.7159) * jnp.tanh(
+           a.get('scale_a', 2.0 / 3.0) * x))
+_unary('thresholded_relu',
+       lambda x, a: jnp.where(x > a.get('threshold', 1.0), x,
+                              jnp.zeros_like(x)))
+_unary('hard_sigmoid',
+       lambda x, a: jnp.clip(a.get('slope', 0.2) * x + a.get('offset', 0.5),
+                             0.0, 1.0))
+_unary('swish', lambda x, a: x * jax.nn.sigmoid(a.get('beta', 1.0) * x))
+_unary('sign', lambda x, a: jnp.sign(x))
+
+
+@register_op('prelu')
+def _prelu(ctx, ins, attrs):
+    x = first(ins, 'X')
+    alpha = first(ins, 'Alpha')
+    return out(jnp.where(x >= 0, x, alpha.reshape(()) * x
+                         if alpha.size == 1 else alpha * x))

@@ -1,0 +1,28 @@
+"""Shared helpers for op implementations."""
+import jax.numpy as jnp
+
+
+def first(ins, slot):
+    vals = ins.get(slot) or []
+    return vals[0] if vals else None
+
+
+def out(x):
+    return {'Out': [x]}
+
+
+def f32(x):
+    """Accumulate in float32 (MXU-friendly: inputs may be bf16)."""
+    return x.astype(jnp.float32)
+
+
+def bcast_axis(x, y, axis):
+    """Fluid elementwise broadcast: y's shape must match a contiguous
+    suffix-run of x's shape starting at `axis`.  Reshape y with trailing
+    1s so numpy broadcasting applies."""
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)

@@ -1,0 +1,131 @@
+"""Convolution ops.
+
+Reference parity: paddle/operators/{conv_op,conv_cudnn_op,conv_transpose_op,
+conv_shift_op,row_conv_op}.*.  All lower to lax.conv_general_dilated which
+XLA tiles onto the MXU; bf16 inputs accumulate in fp32.  User-facing layout
+is NCHW (parity with fluid); pass data_format='NHWC' for the TPU-preferred
+layout (the flagship models do).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+_ACC = dict(preferred_element_type=jnp.float32)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+@register_op('conv2d')
+def _conv2d(ctx, ins, attrs):
+    x = first(ins, 'Input')
+    w = first(ins, 'Filter')  # OIHW
+    strides = _pair(attrs.get('strides', [1, 1]))
+    paddings = _pair(attrs.get('paddings', [0, 0]))
+    dilations = _pair(attrs.get('dilations', [1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    fmt = attrs.get('data_format', 'NCHW')
+    dn = (fmt, 'OIHW', fmt)
+    y = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        **_ACC)
+    return {'Output': [y.astype(x.dtype)]}
+
+
+@register_op('conv3d')
+def _conv3d(ctx, ins, attrs):
+    x = first(ins, 'Input')
+    w = first(ins, 'Filter')  # OIDHW
+    strides = _pair(attrs.get('strides', [1, 1, 1]), 3)
+    paddings = _pair(attrs.get('paddings', [0, 0, 0]), 3)
+    dilations = _pair(attrs.get('dilations', [1, 1, 1]), 3)
+    groups = attrs.get('groups', 1) or 1
+    y = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+        feature_group_count=groups,
+        **_ACC)
+    return {'Output': [y.astype(x.dtype)]}
+
+
+def _conv_transpose(x, w, strides, paddings, dilations, spatial):
+    """conv_transpose via input-dilated conv: output = (H-1)*s - 2p + k."""
+    # w comes as (in_c, out_c, k...) -> (out_c, in_c, k...) flipped
+    perm = (1, 0) + tuple(range(2, 2 + spatial))
+    wt = jnp.transpose(w, perm)
+    wt = jnp.flip(wt, axis=tuple(range(2, 2 + spatial)))
+    k = [wt.shape[2 + i] for i in range(spatial)]
+    pad = [((k[i] - 1) * dilations[i] - paddings[i],
+            (k[i] - 1) * dilations[i] - paddings[i]) for i in range(spatial)]
+    dn = ('NCHW', 'OIHW', 'NCHW') if spatial == 2 else \
+         ('NCDHW', 'OIDHW', 'NCDHW')
+    y = jax.lax.conv_general_dilated(
+        x, wt.astype(x.dtype),
+        window_strides=[1] * spatial,
+        padding=pad,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        **_ACC)
+    return y.astype(x.dtype)
+
+
+@register_op('conv2d_transpose')
+def _conv2d_transpose(ctx, ins, attrs):
+    x = first(ins, 'Input')
+    w = first(ins, 'Filter')
+    y = _conv_transpose(x, w, _pair(attrs.get('strides', [1, 1])),
+                        _pair(attrs.get('paddings', [0, 0])),
+                        _pair(attrs.get('dilations', [1, 1])), 2)
+    return {'Output': [y]}
+
+
+@register_op('conv3d_transpose')
+def _conv3d_transpose(ctx, ins, attrs):
+    x = first(ins, 'Input')
+    w = first(ins, 'Filter')
+    y = _conv_transpose(x, w, _pair(attrs.get('strides', [1, 1, 1]), 3),
+                        _pair(attrs.get('paddings', [0, 0, 0]), 3),
+                        _pair(attrs.get('dilations', [1, 1, 1]), 3), 3)
+    return {'Output': [y]}
+
+
+@register_op('conv_shift')
+def _conv_shift(ctx, ins, attrs):
+    """Circular 1-D correlation (operators/conv_shift_op.cc): Out[i,j] =
+    sum_k X[i, (j+k-M/2) mod N] * Y[i,k]."""
+    x = first(ins, 'X')  # [B, N]
+    y = first(ins, 'Y')  # [B, M]
+    n = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+    gathered = x[:, idx]  # [B, N, M]
+    return out(jnp.einsum('bnm,bm->bn', gathered, y))
+
+
+@register_op('row_conv')
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (operators/row_conv_op.cc) on padded
+    sequences: Out[b,t] = sum_{k<K} X[b,t+k] * W[k]."""
+    x = first(ins, 'X')  # [B, T, D]
+    w = first(ins, 'Filter')  # [K, D]
+    k = w.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    acc = jnp.zeros_like(x)
+    for i in range(k):
+        acc = acc + xp[:, i:i + x.shape[1], :] * w[i]
+    return out(acc)

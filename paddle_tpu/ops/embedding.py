@@ -1,0 +1,27 @@
+"""Embedding lookup.
+
+Reference parity: paddle/operators/lookup_table_op.* (forward gather;
+sparse SelectedRows grad).  On TPU the gather is a single HLO gather; the
+autodiff grad is a dense scatter-add which XLA handles natively, so
+`is_sparse` is a no-op hint here (SelectedRows applies in ops/optim_ops.py
+when explicitly fed).
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+
+@register_op('lookup_table')
+def _lookup_table(ctx, ins, attrs):
+    w = first(ins, 'W')
+    ids = first(ins, 'Ids').astype(jnp.int32)
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.squeeze(-1)
+    y = jnp.take(w, ids, axis=0)
+    pad = attrs.get('padding_idx', None)
+    if pad is not None and pad >= 0:
+        mask = (ids != pad)[..., None]
+        y = jnp.where(mask, y, jnp.zeros_like(y))
+    return out(y)

@@ -1,0 +1,172 @@
+"""Loss ops.
+
+Reference parity: paddle/operators/{cross_entropy,softmax_with_cross_entropy,
+sigmoid_cross_entropy_with_logits,squared_l2_distance (square_error_cost),
+smooth_l1_loss,hinge_loss,huber_loss,log_loss,rank_loss,margin_rank_loss,
+modified_huber_loss,bpr?,nce}_op.*.  All computed in fp32.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+
+def _label_idx(label):
+    lab = label.astype(jnp.int32)
+    if lab.ndim >= 2 and lab.shape[-1] == 1:
+        lab = lab.squeeze(-1)
+    return lab
+
+
+@register_op('cross_entropy')
+def _cross_entropy(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)  # probabilities [N, D]
+    label = first(ins, 'Label')
+    if attrs.get('soft_label', False):
+        y = -jnp.sum(label.astype(jnp.float32) * jnp.log(x + 1e-12), axis=-1,
+                     keepdims=True)
+    else:
+        lab = _label_idx(label)
+        p = jnp.take_along_axis(x, lab[..., None], axis=-1)
+        y = -jnp.log(p + 1e-12)
+    return {'Y': [y]}
+
+
+@register_op('softmax_with_cross_entropy')
+def _softmax_with_ce(ctx, ins, attrs):
+    logits = first(ins, 'Logits').astype(jnp.float32)
+    label = first(ins, 'Label')
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get('soft_label', False):
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=-1,
+                        keepdims=True)
+    else:
+        lab = _label_idx(label)
+        loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+    return {'Loss': [loss], 'Softmax': [jnp.exp(logp)]}
+
+
+@register_op('sigmoid_cross_entropy_with_logits')
+def _sigmoid_ce(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    label = first(ins, 'Label').astype(jnp.float32)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return out(loss)
+
+
+@register_op('smooth_l1_loss')
+def _smooth_l1(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    sigma = attrs.get('sigma', 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    iw = first(ins, 'InsideWeight')
+    if iw is not None:
+        diff = diff * iw
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff,
+                     ad - 0.5 / s2)
+    ow = first(ins, 'OutsideWeight')
+    if ow is not None:
+        elem = elem * ow
+    loss = jnp.sum(elem.reshape(x.shape[0], -1), axis=1, keepdims=True)
+    return {'Out': [loss], 'Diff': [diff]}
+
+
+@register_op('hinge_loss')
+def _hinge(ctx, ins, attrs):
+    logits = first(ins, 'Logits').astype(jnp.float32)
+    labels = first(ins, 'Labels').astype(jnp.float32)
+    return {'Loss': [jnp.maximum(0.0, 1.0 - (2 * labels - 1) * logits)]}
+
+
+@register_op('huber_loss')
+def _huber(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    delta = attrs.get('delta', 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    return {'Out': [loss], 'Residual': [r]}
+
+
+@register_op('log_loss')
+def _log_loss(ctx, ins, attrs):
+    p = first(ins, 'Predicted').astype(jnp.float32)
+    label = first(ins, 'Labels').astype(jnp.float32)
+    eps = attrs.get('epsilon', 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {'Loss': [loss]}
+
+
+@register_op('rank_loss')
+def _rank_loss(ctx, ins, attrs):
+    label = first(ins, 'Label').astype(jnp.float32)
+    left = first(ins, 'Left').astype(jnp.float32)
+    right = first(ins, 'Right').astype(jnp.float32)
+    d = left - right
+    loss = jnp.log1p(jnp.exp(d)) - label * d
+    return out(loss)
+
+
+@register_op('margin_rank_loss')
+def _margin_rank_loss(ctx, ins, attrs):
+    label = first(ins, 'Label').astype(jnp.float32)
+    x1 = first(ins, 'X1').astype(jnp.float32)
+    x2 = first(ins, 'X2').astype(jnp.float32)
+    margin = attrs.get('margin', 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {'Out': [act], 'Activated': [(act > 0).astype(jnp.float32)]}
+
+
+@register_op('modified_huber_loss')
+def _modified_huber(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    a = (2 * y - 1) * x
+    loss = jnp.where(a < -1, -4 * a,
+                     jnp.where(a < 1, jnp.square(1 - a), 0.0))
+    return {'Out': [loss], 'IntermediateVal': [a]}
+
+
+@register_op('square_error_cost')
+def _square_error_cost(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    return out(jnp.square(x - y))
+
+
+@register_op('nce')
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation loss (operators/nce_op.{cc,h}) with
+    uniform noise distribution; negatives drawn per batch."""
+    x = first(ins, 'Input').astype(jnp.float32)  # [N, D]
+    label = _label_idx(first(ins, 'Label'))  # [N] or [N, num_true]
+    w = first(ins, 'Weight').astype(jnp.float32)  # [num_classes, D]
+    b = first(ins, 'Bias')
+    num_neg = attrs.get('num_neg_samples', 10)
+    num_classes = attrs.get('num_total_classes', w.shape[0])
+    if label.ndim == 1:
+        label = label[:, None]
+    num_true = label.shape[1]
+    neg = jax.random.randint(ctx.rng(), (x.shape[0], num_neg), 0,
+                             num_classes)
+    samples = jnp.concatenate([label, neg], axis=1)  # [N, T+S]
+    sw = w[samples]  # [N, T+S, D]
+    logits = jnp.einsum('nd,nsd->ns', x, sw)
+    if b is not None:
+        logits = logits + b.astype(jnp.float32)[samples]
+    p_noise = num_neg / float(num_classes)
+    # true part
+    lt = logits[:, :num_true]
+    pos = jnp.log1p(jnp.exp(-(lt - jnp.log(p_noise))))
+    ls = logits[:, num_true:]
+    negl = jnp.log1p(jnp.exp(ls - jnp.log(p_noise)))
+    cost = jnp.sum(pos, axis=1, keepdims=True) + \
+        jnp.sum(negl, axis=1, keepdims=True)
+    return {'Cost': [cost], 'SampleLogits': [logits],
+            'SampleLabels': [samples.astype(jnp.int32)]}

@@ -1,0 +1,228 @@
+"""Math ops: matmul family, elementwise family, reductions, softmax.
+
+Reference parity: paddle/operators/{mul,matmul,elementwise_*,scale,sum,
+minus,mean,clip,clip_by_norm,reduce,softmax,cos_sim,norm,top_k}_op.*.
+Matmuls run on the MXU; `preferred_element_type=float32` keeps bf16 inputs
+accumulating in fp32 (the TPU-native mixed-precision recipe).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import bcast_axis, first, out
+
+_ACC = dict(preferred_element_type=jnp.float32)
+
+
+def _matmul_acc(a, b):
+    y = jnp.matmul(a, b, **_ACC)
+    return y.astype(a.dtype)
+
+
+@register_op('mul')
+def _mul(ctx, ins, attrs):
+    """Fluid `mul`: flatten X to 2-D at x_num_col_dims, Y at
+    y_num_col_dims, then matmul (operators/mul_op.cc)."""
+    x = first(ins, 'X')
+    y = first(ins, 'Y')
+    xnc = attrs.get('x_num_col_dims', 1)
+    ync = attrs.get('y_num_col_dims', 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(_prod(xs[:xnc])), int(_prod(xs[xnc:]))))
+    y2 = y.reshape((int(_prod(ys[:ync])), int(_prod(ys[ync:]))))
+    o = _matmul_acc(x2, y2)
+    return out(o.reshape(xs[:xnc] + ys[ync:]))
+
+
+def _prod(t):
+    p = 1
+    for d in t:
+        p *= int(d)
+    return p
+
+
+@register_op('matmul')
+def _matmul(ctx, ins, attrs):
+    x = first(ins, 'X')
+    y = first(ins, 'Y')
+    if attrs.get('transpose_X', False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get('transpose_Y', False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    if x.ndim == 1 and y.ndim == 1:
+        return out(jnp.dot(x, y, **_ACC).astype(x.dtype))
+    return out(_matmul_acc(x, y) * attrs.get('alpha', 1.0))
+
+
+def _elementwise(name, fn):
+    @register_op('elementwise_' + name)
+    def _impl(ctx, ins, attrs, _fn=fn):
+        x = first(ins, 'X')
+        y = bcast_axis(x, first(ins, 'Y'), attrs.get('axis', -1))
+        return out(_fn(x, y))
+
+    return _impl
+
+
+_elementwise('add', jnp.add)
+_elementwise('sub', jnp.subtract)
+_elementwise('mul', jnp.multiply)
+_elementwise('div', jnp.divide)
+_elementwise('pow', jnp.power)
+_elementwise('max', jnp.maximum)
+_elementwise('min', jnp.minimum)
+_elementwise('mod', jnp.mod)
+
+
+@register_op('scale')
+def _scale(ctx, ins, attrs):
+    x = first(ins, 'X')
+    scale = attrs.get('scale', 1.0)
+    bias = attrs.get('bias', 0.0)
+    if attrs.get('bias_after_scale', True):
+        return out(x * scale + bias)
+    return out((x + bias) * scale)
+
+
+@register_op('sum')
+def _sum(ctx, ins, attrs):
+    xs = ins.get('X', [])
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = acc + x
+    return out(acc)
+
+
+@register_op('minus')
+def _minus(ctx, ins, attrs):
+    return out(first(ins, 'X') - first(ins, 'Y'))
+
+
+@register_op('mean')
+def _mean(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(jnp.mean(x.astype(jnp.float32)).astype(x.dtype).reshape((1,)))
+
+
+@register_op('clip')
+def _clip(ctx, ins, attrs):
+    return out(jnp.clip(first(ins, 'X'), attrs['min'], attrs['max']))
+
+
+@register_op('clip_by_norm')
+def _clip_by_norm(ctx, ins, attrs):
+    x = first(ins, 'X')
+    max_norm = attrs['max_norm']
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return out((x.astype(jnp.float32) * scale).astype(x.dtype))
+
+
+def _reduce(name, fn):
+    @register_op('reduce_' + name)
+    def _impl(ctx, ins, attrs, _fn=fn):
+        x = first(ins, 'X')
+        dim = attrs.get('dim', None)
+        keep_dim = attrs.get('keep_dim', False)
+        if attrs.get('reduce_all', dim is None):
+            r = _fn(x, axis=None, keepdims=keep_dim)
+        else:
+            axes = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+            r = _fn(x, axis=axes, keepdims=keep_dim)
+        if r.ndim == 0:
+            r = r.reshape((1,))
+        return out(r)
+
+    return _impl
+
+
+_reduce('sum', jnp.sum)
+_reduce('mean', jnp.mean)
+_reduce('max', jnp.max)
+_reduce('min', jnp.min)
+_reduce('prod', jnp.prod)
+
+
+@register_op('softmax')
+def _softmax(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(jax.nn.softmax(x.astype(jnp.float32),
+                              axis=-1).astype(x.dtype))
+
+
+@register_op('cos_sim')
+def _cos_sim(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    if y.shape[0] == 1 and x.shape[0] != 1:
+        y = jnp.broadcast_to(y, x.shape)
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    o = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {'Out': [o], 'XNorm': [xn], 'YNorm': [yn]}
+
+
+@register_op('l1_norm')
+def _l1_norm(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(jnp.sum(jnp.abs(x.astype(jnp.float32))).reshape((1,)))
+
+
+@register_op('squared_l2_norm')
+def _squared_l2_norm(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(jnp.sum(jnp.square(x.astype(jnp.float32))).reshape((1,)))
+
+
+@register_op('squared_l2_distance')
+def _squared_l2_distance(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    if y.shape[0] == 1 and x.shape[0] != 1:
+        y = jnp.broadcast_to(y, x.shape)
+    diff = x - y
+    o = jnp.sum(jnp.square(diff).reshape(x.shape[0], -1), axis=1,
+                keepdims=True)
+    return {'Out': [o], 'sub_result': [diff]}
+
+
+@register_op('top_k')
+def _top_k(ctx, ins, attrs):
+    x = first(ins, 'X')
+    k = attrs.get('k', 1)
+    vals, idxs = jax.lax.top_k(x, k)
+    return {'Out': [vals], 'Indices': [idxs.astype(jnp.int32)]}
+
+
+@register_op('norm')
+def _norm(ctx, ins, attrs):
+    """L2-normalize along axis (operators/norm_op)."""
+    x = first(ins, 'X').astype(jnp.float32)
+    axis = attrs.get('axis', 1)
+    eps = attrs.get('epsilon', 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {'Out': [(x / norm).astype(first(ins, 'X').dtype)],
+            'Norm': [norm]}
+
+
+@register_op('maxout')
+def _maxout(ctx, ins, attrs):
+    x = first(ins, 'X')  # NCHW
+    groups = attrs['groups']
+    n, c, h, w = x.shape
+    return out(jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2))
+
+
+@register_op('bilinear_tensor_product')
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """Out[n,k] = X[n,:] @ W[k] @ Y[n,:] + b (operators/
+    bilinear_tensor_product_op.cc)."""
+    x = first(ins, 'X').astype(jnp.float32)
+    y = first(ins, 'Y').astype(jnp.float32)
+    w = first(ins, 'Weight').astype(jnp.float32)
+    o = jnp.einsum('ni,kij,nj->nk', x, w, y)
+    b = first(ins, 'Bias')
+    if b is not None:
+        o = o + b.astype(jnp.float32).reshape(1, -1)
+    return out(o.astype(first(ins, 'X').dtype))

@@ -1,0 +1,276 @@
+"""Metric ops.
+
+Reference parity: paddle/operators/{accuracy,auc,precision_recall,
+edit_distance,positive_negative_pair}_op.*.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+@register_op('accuracy')
+def _accuracy(ctx, ins, attrs):
+    """Top-k indices in 'Out' (from a top_k op) vs int labels."""
+    idx = first(ins, 'Indices').astype(jnp.int32)
+    label = first(ins, 'Label').astype(jnp.int32)
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    hit = jnp.any(idx == label[:, None], axis=1)
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    correct = jnp.sum(hit).astype(jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {'Accuracy': [acc.reshape((1,))], 'Correct': [correct.reshape((1,))],
+            'Total': [total.reshape((1,))]}
+
+
+@register_op('auc')
+def _auc(ctx, ins, attrs):
+    """Streaming-free AUC over the batch via thresholded confusion counts
+    (reference uses 200 thresholds in auc_op.h)."""
+    probs = first(ins, 'Out').astype(jnp.float32)
+    label = first(ins, 'Label').astype(jnp.int32).reshape(-1)
+    if probs.ndim == 2 and probs.shape[1] == 2:
+        score = probs[:, 1]
+    else:
+        score = probs.reshape(-1)
+    num_t = 200
+    thresholds = (jnp.arange(num_t, dtype=jnp.float32) + 0.5) / num_t
+    pos = (label == 1)
+    above = score[None, :] >= thresholds[:, None]
+    tp = jnp.sum(above & pos[None, :], axis=1).astype(jnp.float32)
+    fp = jnp.sum(above & ~pos[None, :], axis=1).astype(jnp.float32)
+    npos = jnp.maximum(jnp.sum(pos).astype(jnp.float32), 1e-6)
+    nneg = jnp.maximum(jnp.sum(~pos).astype(jnp.float32), 1e-6)
+    tpr = tp / npos
+    fpr = fp / nneg
+    # trapezoid over decreasing threshold order
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {'AUC': [jnp.abs(auc).reshape((1,))]}
+
+
+@register_op('precision_recall')
+def _precision_recall(ctx, ins, attrs):
+    """Per-class macro/micro precision, recall, F1 for the batch."""
+    num_classes = attrs['class_number']
+    idx = first(ins, 'MaxProbs')
+    pred = first(ins, 'Indices').astype(jnp.int32).reshape(-1)
+    label = first(ins, 'Labels').astype(jnp.int32).reshape(-1)
+    cls = jnp.arange(num_classes)
+    pred_is = pred[None, :] == cls[:, None]
+    lab_is = label[None, :] == cls[:, None]
+    tp = jnp.sum(pred_is & lab_is, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred_is & ~lab_is, axis=1).astype(jnp.float32)
+    fn = jnp.sum(~pred_is & lab_is, axis=1).astype(jnp.float32)
+    prec = tp / jnp.maximum(tp + fp, 1e-6)
+    rec = tp / jnp.maximum(tp + fn, 1e-6)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    stp, sfp, sfn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    mprec = stp / jnp.maximum(stp + sfp, 1e-6)
+    mrec = stp / jnp.maximum(stp + sfn, 1e-6)
+    mf1 = 2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-6)
+    micro = jnp.stack([mprec, mrec, mf1])
+    metrics = jnp.concatenate([macro, micro]).reshape(1, 6)
+    states = jnp.stack([tp, fp, fn, tp * 0], axis=1)
+    return {'BatchMetrics': [metrics], 'AccumMetrics': [metrics],
+            'AccumStatesInfo': [states]}
+
+
+@register_op('edit_distance')
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between padded hypothesis/reference token rows
+    (operators/edit_distance_op) via dynamic-programming lax.scan."""
+    hyp = first(ins, 'Hyps').astype(jnp.int32)
+    ref = first(ins, 'Refs').astype(jnp.int32)
+    hyp_len = first(ins, 'HypsLen')
+    ref_len = first(ins, 'RefsLen')
+    if hyp.ndim == 1:
+        hyp = hyp[None, :]
+        ref = ref[None, :]
+    b, m = hyp.shape
+    _, n = ref.shape
+    if hyp_len is None:
+        hyp_len = jnp.full((b,), m, jnp.int32)
+    if ref_len is None:
+        ref_len = jnp.full((b,), n, jnp.int32)
+    hyp_len = hyp_len.reshape(-1).astype(jnp.int32)
+    ref_len = ref_len.reshape(-1).astype(jnp.int32)
+
+    def per_seq(h, r, hl, rl):
+        row0 = jnp.arange(n + 1, dtype=jnp.float32)
+        row0 = jnp.where(jnp.arange(n + 1) <= rl, row0, jnp.inf)
+
+        def step(row, i):
+            cost_sub = (r != h[i]).astype(jnp.float32)
+            valid = (i < hl)
+
+            def inner(prev_row):
+                new = jnp.full((n + 1,), jnp.inf)
+                new = new.at[0].set(i + 1.0)
+
+                def body(j, nr):
+                    d = jnp.minimum(
+                        jnp.minimum(nr[j - 1] + 1, prev_row[j] + 1),
+                        prev_row[j - 1] + cost_sub[j - 1])
+                    return nr.at[j].set(d)
+
+                return jax.lax.fori_loop(1, n + 1, body, new)
+
+            row = jnp.where(valid, inner(row), row)
+            return row, None
+
+        rowf, _ = jax.lax.scan(step, row0, jnp.arange(m))
+        return rowf[rl]
+
+    d = jax.vmap(per_seq)(hyp, ref, hyp_len, ref_len)
+    if attrs.get('normalized', True):
+        d = d / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    return {'Out': [d.reshape(b, 1)],
+            'SequenceNum': [jnp.asarray([b], jnp.int32)]}
+
+
+@register_op('positive_negative_pair')
+def _pos_neg_pair(ctx, ins, attrs):
+    score = first(ins, 'Score').astype(jnp.float32).reshape(-1)
+    label = first(ins, 'Label').astype(jnp.float32).reshape(-1)
+    qid = first(ins, 'QueryID').astype(jnp.int32).reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    li = label[:, None]
+    lj = label[None, :]
+    si = score[:, None]
+    sj = score[None, :]
+    mask = same_q & (li > lj)
+    pos = jnp.sum(mask & (si > sj))
+    neg = jnp.sum(mask & (si < sj))
+    neu = jnp.sum(mask & (si == sj))
+    pos = pos.astype(jnp.float32) + 0.5 * neu
+    neg = neg.astype(jnp.float32) + 0.5 * neu
+    ratio = pos / jnp.maximum(neg, 1e-6)
+    return {'PositivePair': [pos.reshape((1,))],
+            'NegativePair': [neg.reshape((1,))],
+            'NeutralPair': [neu.astype(jnp.float32).reshape((1,))],
+            'PositiveRatio': [ratio.reshape((1,))]}
+
+
+def _chunk_flags(tags, num_chunk_types, scheme, valid):
+    """Per-position (in_chunk, type, start, end) for a [B, T] tag batch
+    under the conll chunking schemes the reference supports
+    (operators/chunk_eval_op.h): plain, IOB, IOE, IOBES."""
+    t = tags.shape[1]
+    if scheme == 'plain':
+        n_tag = 1
+        kind = jnp.zeros_like(tags)
+        ctype = tags
+        outside = tags >= num_chunk_types
+    else:
+        n_tag = {'IOB': 2, 'IOE': 2, 'IOBES': 4}[scheme]
+        kind = tags % n_tag
+        ctype = tags // n_tag
+        outside = tags >= num_chunk_types * n_tag
+    in_chunk = (~outside) & valid
+    ctype = jnp.where(in_chunk, ctype, -1)
+
+    prev_in = jnp.pad(in_chunk, ((0, 0), (1, 0)))[:, :t]
+    prev_type = jnp.pad(ctype, ((0, 0), (1, 0)),
+                        constant_values=-1)[:, :t]
+    next_in = jnp.pad(in_chunk, ((0, 0), (0, 1)))[:, 1:]
+    next_type = jnp.pad(ctype, ((0, 0), (0, 1)),
+                        constant_values=-1)[:, 1:]
+    boundary_prev = (~prev_in) | (prev_type != ctype)
+    boundary_next = (~next_in) | (next_type != ctype)
+
+    if scheme == 'plain':
+        start = in_chunk & boundary_prev
+        end = in_chunk & boundary_next
+    elif scheme == 'IOB':  # kinds: B=0, I=1
+        start = in_chunk & ((kind == 0) | boundary_prev)
+        nxt_starts = next_in & ((jnp.pad(kind, ((0, 0), (0, 1)))[:, 1:]
+                                 == 0))
+        end = in_chunk & (boundary_next | nxt_starts)
+    elif scheme == 'IOE':  # kinds: I=0, E=1
+        prev_ended = prev_in & (jnp.pad(kind, ((0, 0), (1, 0)))[:, :t] == 1)
+        start = in_chunk & (boundary_prev | prev_ended)
+        end = in_chunk & ((kind == 1) | boundary_next)
+    else:  # IOBES: B=0, I=1, E=2, S=3
+        start = in_chunk & ((kind == 0) | (kind == 3) | boundary_prev)
+        end = in_chunk & ((kind == 2) | (kind == 3) | boundary_next)
+    return in_chunk, ctype, start, end
+
+
+@register_op('chunk_eval')
+def _chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 (operators/chunk_eval_op).  A chunk
+    is correct iff its [start, end] span and type agree exactly between
+    inference and label."""
+    inference = first(ins, 'Inference').astype(jnp.int32)
+    label = first(ins, 'Label').astype(jnp.int32)
+    if inference.ndim == 3:
+        inference = inference[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    lengths = first(ins, 'XLen')
+    b, t = label.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    lengths = lengths.astype(jnp.int32).reshape(-1)
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    scheme = attrs.get('chunk_scheme', 'IOB')
+    num_types = attrs['num_chunk_types']
+    excluded = jnp.asarray(attrs.get('excluded_chunk_types') or [-99],
+                           jnp.int32)
+
+    i_in, i_ty, i_st, i_en = _chunk_flags(inference, num_types, scheme,
+                                          valid)
+    l_in, l_ty, l_st, l_en = _chunk_flags(label, num_types, scheme, valid)
+
+    def count(in_c, ty, st):
+        ok = st & ~jnp.isin(ty, excluded)
+        return jnp.sum(ok)
+
+    num_infer = count(i_in, i_ty, i_st)
+    num_label = count(l_in, l_ty, l_st)
+
+    # a chunk matches when both sides agree on (in_chunk, type) at every
+    # position of the span and share the same start/end flags.
+    agree = (i_in == l_in) & (i_ty == l_ty)
+    both_start = i_st & l_st & agree & ~jnp.isin(l_ty, excluded)
+    both_end = i_en & l_en & agree
+    # mismatch prefix-sums let us check "agree over the whole span"
+    mismatch = (~agree).astype(jnp.int32)
+    mis_cum = jnp.cumsum(mismatch, axis=1)
+
+    def row_correct(bs, be, mc):
+        # for each start s (both_start), find its end: the first position
+        # e >= s with both_end; correct iff no mismatch within [s, e].
+        t_idx = jnp.arange(t)
+        # end position for the label chunk starting at s: next l_en >= s
+        def first_end_from(s):
+            cand = jnp.where((t_idx >= s) & be, t_idx, t)
+            return jnp.min(cand)
+
+        ends = jax.vmap(first_end_from)(t_idx)
+        span_clean = jnp.where(
+            ends < t,
+            (mc[jnp.minimum(ends, t - 1)] -
+             jnp.where(t_idx > 0, mc[jnp.maximum(t_idx - 1, 0)], 0)) == 0,
+            False)
+        return jnp.sum(bs & span_clean)
+
+    num_correct = jnp.sum(jax.vmap(row_correct)(both_start, both_end,
+                                                mis_cum))
+    num_infer_f = num_infer.astype(jnp.float32)
+    num_label_f = num_label.astype(jnp.float32)
+    num_correct_f = num_correct.astype(jnp.float32)
+    precision = num_correct_f / jnp.maximum(num_infer_f, 1e-6)
+    recall = num_correct_f / jnp.maximum(num_label_f, 1e-6)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-6)
+    return {
+        'Precision': [precision.reshape((1,))],
+        'Recall': [recall.reshape((1,))],
+        'F1-Score': [f1.reshape((1,))],
+        'NumInferChunks': [num_infer.astype(jnp.int32).reshape((1,))],
+        'NumLabelChunks': [num_label.astype(jnp.int32).reshape((1,))],
+        'NumCorrectChunks': [num_correct.astype(jnp.int32).reshape((1,))],
+    }

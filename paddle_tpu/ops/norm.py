@@ -1,0 +1,96 @@
+"""Normalization ops.
+
+Reference parity: paddle/operators/{batch_norm_op,layer_norm?,lrn_op}.*.
+Batch-norm statistics are computed/kept in float32 even for bf16 activations
+(TPU mixed-precision recipe); running-stat updates ride the executor's
+persistable-state mechanism (MeanOut/VarianceOut alias Mean/Variance).
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+@register_op('batch_norm')
+def _batch_norm(ctx, ins, attrs):
+    x = first(ins, 'X')
+    scale = first(ins, 'Scale').astype(jnp.float32)
+    bias = first(ins, 'Bias').astype(jnp.float32)
+    mean = first(ins, 'Mean').astype(jnp.float32)
+    var = first(ins, 'Variance').astype(jnp.float32)
+    eps = attrs.get('epsilon', 1e-5)
+    momentum = attrs.get('momentum', 0.9)
+    is_test = attrs.get('is_test', False)
+    layout = attrs.get('data_layout', 'NCHW')
+
+    ch_axis = 1 if layout == 'NCHW' else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    xf = x.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.var(xf, axis=axes)
+        mean_out = momentum * mean + (1 - momentum) * use_mean
+        var_out = momentum * var + (1 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+    inv = jnp.asarray(1.0, jnp.float32) / jnp.sqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        'Y': [y.astype(x.dtype)],
+        'MeanOut': [mean_out],
+        'VarianceOut': [var_out],
+        'SavedMean': [saved_mean],
+        'SavedVariance': [saved_var],
+    }
+
+
+@register_op('layer_norm')
+def _layer_norm(ctx, ins, attrs):
+    x = first(ins, 'X')
+    scale = first(ins, 'Scale')
+    bias = first(ins, 'Bias')
+    eps = attrs.get('epsilon', 1e-5)
+    begin = attrs.get('begin_norm_axis', 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32).reshape(
+            (1,) * begin + x.shape[begin:])
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(
+            (1,) * begin + x.shape[begin:])
+    return {'Y': [y.astype(x.dtype)], 'Mean': [mean.reshape(x.shape[:begin])],
+            'Variance': [var.reshape(x.shape[:begin])]}
+
+
+@register_op('lrn')
+def _lrn(ctx, ins, attrs):
+    """Local response normalization across channels (operators/lrn_op.cc):
+    Out = X / (k + alpha * sum_{local} X^2)^beta."""
+    x = first(ins, 'X')  # NCHW
+    n = attrs.get('n', 5)
+    k = attrs.get('k', 2.0)
+    alpha = attrs.get('alpha', 1e-4)
+    beta = attrs.get('beta', 0.75)
+    xf = x.astype(jnp.float32)
+    sq = jnp.square(xf)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(xf)
+    for i in range(n):
+        acc = acc + pad[:, i:i + x.shape[1]]
+    mid = k + alpha * acc
+    return {'Out': [(xf / jnp.power(mid, beta)).astype(x.dtype)],
+            'MidOut': [mid]}

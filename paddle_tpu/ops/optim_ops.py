@@ -1,0 +1,194 @@
+"""Optimizer update ops.
+
+Reference parity: paddle/operators/{sgd,momentum,adam,adamax,adagrad,
+decayed_adagrad,adadelta,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.*.
+Each is a functional update: reads param/grad/moments, returns new values;
+the executor's donated persistable state makes them in-place on device.
+Sparse (SelectedRows) grads arrive as a (rows, values) pair handled by
+segment-sum scatter.
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+def _p32(x):
+    return x.astype(jnp.float32)
+
+
+def _sparse_to_update(param, grad):
+    """If grad is a (rows, values) tuple, scatter-add values into a dense
+    zero grad (TPU handles dense scatter efficiently)."""
+    if isinstance(grad, tuple):
+        rows, values = grad
+        dense = jnp.zeros(param.shape, jnp.float32)
+        return dense.at[rows.astype(jnp.int32)].add(_p32(values))
+    return _p32(grad)
+
+
+@register_op('sgd')
+def _sgd(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    return {'ParamOut': [(_p32(p) - lr * g).astype(p.dtype)]}
+
+
+@register_op('momentum')
+def _momentum(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    v = _p32(first(ins, 'Velocity'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    mu = attrs.get('mu', 0.9)
+    v_new = mu * v + g
+    if attrs.get('use_nesterov', False):
+        p_new = _p32(p) - (g + mu * v_new) * lr
+    else:
+        p_new = _p32(p) - lr * v_new
+    return {'ParamOut': [p_new.astype(p.dtype)], 'VelocityOut': [v_new]}
+
+
+@register_op('adam')
+def _adam(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    m = _p32(first(ins, 'Moment1'))
+    v = _p32(first(ins, 'Moment2'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    b1p = _p32(first(ins, 'Beta1Pow')).reshape(())
+    b2p = _p32(first(ins, 'Beta2Pow')).reshape(())
+    b1 = attrs.get('beta1', 0.9)
+    b2 = attrs.get('beta2', 0.999)
+    eps = attrs.get('epsilon', 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = _p32(p) - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {'ParamOut': [p_new.astype(p.dtype)], 'Moment1Out': [m_new],
+            'Moment2Out': [v_new]}
+
+
+@register_op('adamax')
+def _adamax(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    m = _p32(first(ins, 'Moment'))
+    u = _p32(first(ins, 'InfNorm'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    b1p = _p32(first(ins, 'Beta1Pow')).reshape(())
+    b1 = attrs.get('beta1', 0.9)
+    b2 = attrs.get('beta2', 0.999)
+    eps = attrs.get('epsilon', 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    p_new = _p32(p) - (lr / (1 - b1p)) * m_new / (u_new + eps)
+    return {'ParamOut': [p_new.astype(p.dtype)], 'MomentOut': [m_new],
+            'InfNormOut': [u_new]}
+
+
+@register_op('adagrad')
+def _adagrad(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    mom = _p32(first(ins, 'Moment'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    eps = attrs.get('epsilon', 1e-6)
+    mom_new = mom + jnp.square(g)
+    p_new = _p32(p) - lr * g / (jnp.sqrt(mom_new) + eps)
+    return {'ParamOut': [p_new.astype(p.dtype)], 'MomentOut': [mom_new]}
+
+
+@register_op('decayed_adagrad')
+def _decayed_adagrad(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    mom = _p32(first(ins, 'Moment'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    decay = attrs.get('decay', 0.95)
+    eps = attrs.get('epsilon', 1e-6)
+    mom_new = decay * mom + (1 - decay) * jnp.square(g)
+    p_new = _p32(p) - lr * g / (jnp.sqrt(mom_new) + eps)
+    return {'ParamOut': [p_new.astype(p.dtype)], 'MomentOut': [mom_new]}
+
+
+@register_op('adadelta')
+def _adadelta(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    avg_sq_grad = _p32(first(ins, 'AvgSquaredGrad'))
+    avg_sq_upd = _p32(first(ins, 'AvgSquaredUpdate'))
+    rho = attrs.get('rho', 0.95)
+    eps = attrs.get('epsilon', 1e-6)
+    asg_new = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_new + eps)) * g
+    asu_new = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    return {'ParamOut': [(_p32(p) + update).astype(p.dtype)],
+            'AvgSquaredGradOut': [asg_new],
+            'AvgSquaredUpdateOut': [asu_new]}
+
+
+@register_op('rmsprop')
+def _rmsprop(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    ms = _p32(first(ins, 'MeanSquare'))
+    mom = _p32(first(ins, 'Moment'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    decay = attrs.get('decay', 0.9)
+    mu = attrs.get('momentum', 0.0)
+    eps = attrs.get('epsilon', 1e-10)
+    ms_new = decay * ms + (1 - decay) * jnp.square(g)
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {'ParamOut': [(_p32(p) - mom_new).astype(p.dtype)],
+            'MeanSquareOut': [ms_new], 'MomentOut': [mom_new]}
+
+
+@register_op('ftrl')
+def _ftrl(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    sq = _p32(first(ins, 'SquaredAccumulator'))
+    lin = _p32(first(ins, 'LinearAccumulator'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    l1 = attrs.get('l1', 0.0)
+    l2 = attrs.get('l2', 0.0)
+    lr_power = attrs.get('lr_power', -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * _p32(p)
+    x = jnp.clip(new_lin, -l1, l1) - new_lin
+    y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    p_new = x / y
+    return {'ParamOut': [p_new.astype(p.dtype)],
+            'SquaredAccumOut': [new_sq], 'LinearAccumOut': [new_lin]}
+
+
+@register_op('proximal_gd')
+def _proximal_gd(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    l1 = attrs.get('l1', 0.0)
+    l2 = attrs.get('l2', 0.0)
+    prox = _p32(p) - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
+        (1.0 + lr * l2)
+    return {'ParamOut': [p_new.astype(p.dtype)]}
+
+
+@register_op('proximal_adagrad')
+def _proximal_adagrad(ctx, ins, attrs):
+    p = first(ins, 'Param')
+    g = _sparse_to_update(p, first(ins, 'Grad'))
+    mom = _p32(first(ins, 'Moment'))
+    lr = _p32(first(ins, 'LearningRate')).reshape(())
+    l1 = attrs.get('l1', 0.0)
+    l2 = attrs.get('l2', 0.0)
+    mom_new = mom + jnp.square(g)
+    lr_t = lr / jnp.sqrt(mom_new)
+    prox = _p32(p) - lr_t * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / \
+        (1.0 + lr_t * l2)
+    return {'ParamOut': [p_new.astype(p.dtype)], 'MomentOut': [mom_new]}

@@ -1,0 +1,147 @@
+"""Pooling ops via lax.reduce_window.
+
+Reference parity: paddle/operators/{pool_op,pool_cudnn_op,
+pool_with_index_op,spp_op,unpool_op}.*.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import first
+
+
+def _pair(v, n=2):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+def _pool2d(x, pooling_type, ksize, strides, paddings, global_pooling,
+            exclusive=True, fmt='NCHW'):
+    if fmt == 'NCHW':
+        sp = (2, 3)
+    else:
+        sp = (1, 2)
+    if global_pooling:
+        ksize = [x.shape[sp[0]], x.shape[sp[1]]]
+        paddings = [0, 0]
+    window = [1, 1, 1, 1]
+    stride = [1, 1, 1, 1]
+    pad = [(0, 0)] * 4
+    for i, d in enumerate(sp):
+        window[d] = ksize[i]
+        stride[d] = strides[i]
+        pad[d] = (paddings[i], paddings[i])
+    if pooling_type == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, stride,
+                                     pad)
+    s = jax.lax.reduce_window(x.astype(jnp.float32), 0.0, jax.lax.add,
+                              window, stride, pad)
+    if exclusive and (paddings[0] or paddings[1]):
+        ones = jnp.ones(x.shape, jnp.float32)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride,
+                                    pad)
+        return (s / cnt).astype(x.dtype)
+    return (s / float(np.prod(ksize))).astype(x.dtype)
+
+
+@register_op('pool2d')
+def _pool2d_op(ctx, ins, attrs):
+    x = first(ins, 'X')
+    y = _pool2d(x, attrs.get('pooling_type', 'max'),
+                _pair(attrs.get('ksize', [2, 2])),
+                _pair(attrs.get('strides', [1, 1])),
+                _pair(attrs.get('paddings', [0, 0])),
+                attrs.get('global_pooling', False),
+                attrs.get('exclusive', True),
+                attrs.get('data_format', 'NCHW'))
+    return {'Out': [y]}
+
+
+@register_op('pool3d')
+def _pool3d_op(ctx, ins, attrs):
+    x = first(ins, 'X')
+    ksize = _pair(attrs.get('ksize', [2, 2, 2]), 3)
+    strides = _pair(attrs.get('strides', [1, 1, 1]), 3)
+    paddings = _pair(attrs.get('paddings', [0, 0, 0]), 3)
+    if attrs.get('global_pooling', False):
+        ksize = list(x.shape[2:])
+        paddings = [0, 0, 0]
+    window = [1, 1] + ksize
+    stride = [1, 1] + strides
+    pad = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    if attrs.get('pooling_type', 'max') == 'max':
+        y = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, stride,
+                                  pad)
+    else:
+        s = jax.lax.reduce_window(x.astype(jnp.float32), 0.0, jax.lax.add,
+                                  window, stride, pad)
+        y = (s / float(np.prod(ksize))).astype(x.dtype)
+    return {'Out': [y]}
+
+
+@register_op('max_pool2d_with_index')
+def _max_pool_with_index(ctx, ins, attrs):
+    """Returns pooled values and flat spatial argmax indices
+    (operators/pool_with_index_op)."""
+    x = first(ins, 'X')  # NCHW
+    ksize = _pair(attrs.get('ksize', [2, 2]))
+    strides = _pair(attrs.get('strides', ksize))
+    paddings = _pair(attrs.get('paddings', [0, 0]))
+    if attrs.get('global_pooling', False):
+        ksize = list(x.shape[2:])
+        paddings = [0, 0]
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    window = [1, 1, ksize[0], ksize[1]]
+    stride = [1, 1, strides[0], strides[1]]
+    pad = [(0, 0), (0, 0), (paddings[0], paddings[0]),
+           (paddings[1], paddings[1])]
+    vals, idxs = jax.lax.reduce_window(
+        (x.astype(jnp.float32), flat_idx),
+        (-jnp.inf, jnp.float32(-1)),
+        select, window, stride, pad)
+    return {'Out': [vals.astype(x.dtype)], 'Mask': [idxs.astype(jnp.int32)]}
+
+
+@register_op('unpool')
+def _unpool(ctx, ins, attrs):
+    """Max-unpool using indices from max_pool2d_with_index."""
+    x = first(ins, 'X')  # [N,C,h,w]
+    mask = first(ins, 'Indices').astype(jnp.int32)
+    out_h, out_w = attrs['unpooled_height'], attrs['unpooled_width']
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    idx = mask.reshape(n, c, -1)
+    upd = x.reshape(n, c, -1)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    flat = flat.at[ni, ci, idx].add(upd)
+    return {'Out': [flat.reshape(n, c, out_h, out_w)]}
+
+
+@register_op('spp')
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (operators/spp_op.cc)."""
+    x = first(ins, 'X')  # NCHW
+    levels = attrs.get('pyramid_height', 3)
+    pool_type = attrs.get('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(levels):
+        bins = 2 ** level
+        kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        y = _pool2d(x, pool_type, [kh, kw], [kh, kw], [ph, pw], False,
+                    exclusive=False)
+        outs.append(y.reshape(n, -1))
+    return {'Out': [jnp.concatenate(outs, axis=1)]}

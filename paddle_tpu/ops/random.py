@@ -1,0 +1,86 @@
+"""Random ops — threaded PRNG keys (no global mutable RNG state on device).
+
+Reference parity: paddle/operators/{uniform_random,gaussian_random,
+dropout}_op.*.  Keys derive deterministically from (program seed, step,
+block, op index) via ExecutionContext.rng(), so dropout masks are identical
+between the forward interpretation and its autodiff replay.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import datatypes
+from ..core.registry import register_op
+from .common import first, out
+
+
+def _key(ctx, attrs):
+    """Per-op, per-step key.  A nonzero `seed` attr folds into the stream
+    (reproducible but still varying across steps — parity with the
+    reference's seeded Philox streams), it does not freeze it."""
+    seed = attrs.get('seed', 0)
+    key = ctx.rng()
+    if seed:
+        key = jax.random.fold_in(key, seed)
+    return key
+
+
+@register_op('uniform_random', stateful_rng=True)
+def _uniform_random(ctx, ins, attrs):
+    dtype = datatypes.as_numpy_dtype(attrs.get('dtype', 'float32'))
+    if dtype == np.float64:
+        dtype = np.float32
+    shape = tuple(attrs['shape'])
+    u = jax.random.uniform(_key(ctx, attrs), shape, dtype=jnp.float32,
+                           minval=attrs.get('min', -1.0),
+                           maxval=attrs.get('max', 1.0))
+    return out(u.astype(dtype))
+
+
+@register_op('gaussian_random', stateful_rng=True)
+def _gaussian_random(ctx, ins, attrs):
+    dtype = datatypes.as_numpy_dtype(attrs.get('dtype', 'float32'))
+    if dtype == np.float64:
+        dtype = np.float32
+    shape = tuple(attrs['shape'])
+    g = jax.random.normal(_key(ctx, attrs), shape, dtype=jnp.float32)
+    g = g * attrs.get('std', 1.0) + attrs.get('mean', 0.0)
+    return out(g.astype(dtype))
+
+
+@register_op('truncated_gaussian_random', stateful_rng=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    dtype = datatypes.as_numpy_dtype(attrs.get('dtype', 'float32'))
+    shape = tuple(attrs['shape'])
+    g = jax.random.truncated_normal(_key(ctx, attrs), -2.0, 2.0, shape,
+                                    dtype=jnp.float32)
+    g = g * attrs.get('std', 1.0) + attrs.get('mean', 0.0)
+    return out(g.astype(dtype))
+
+
+@register_op('dropout', stateful_rng=True)
+def _dropout(ctx, ins, attrs):
+    x = first(ins, 'X')
+    p = attrs.get('dropout_prob', 0.5)
+    if attrs.get('is_test', False) or p == 0.0:
+        return {'Out': [x], 'Mask': [jnp.ones_like(x)]}
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_key(ctx, attrs), keep, x.shape)
+    # reference keeps scale at train time (inverted dropout)
+    y = jnp.where(mask, x / keep, jnp.zeros_like(x))
+    return {'Out': [y.astype(x.dtype)], 'Mask': [mask.astype(x.dtype)]}
+
+
+@register_op('random_crop', stateful_rng=True)
+def _random_crop(ctx, ins, attrs):
+    x = first(ins, 'X')
+    shape = attrs['shape']
+    key = _key(ctx, attrs)
+    starts = []
+    for i, (xs, os_) in enumerate(zip(x.shape[-len(shape):], shape)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, xs - os_ + 1))
+    batch_dims = x.ndim - len(shape)
+    start_idx = [jnp.asarray(0)] * batch_dims + starts
+    sizes = list(x.shape[:batch_dims]) + list(shape)
+    return out(jax.lax.dynamic_slice(x, start_idx, sizes))

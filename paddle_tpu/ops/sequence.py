@@ -1,0 +1,230 @@
+"""Sequence (LoD) ops on the padded+lengths representation.
+
+Reference parity: paddle/operators/sequence_*_op.* and
+paddle/operators/math/sequence_*.  The reference stores ragged batches as a
+flat tensor + offset table (LoD) and walks offsets on the host; TPU-native
+design keeps a dense [batch, max_time, ...] tensor + int32 lengths [batch]
+and uses masks — static shapes, fully vectorized, MXU/VPU friendly.
+
+Convention: ops take slot 'X' (padded) and optional slot 'XLen' (lengths).
+Missing lengths means "every row is full length".
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+
+def _lengths(ins, slot, x, time_axis=1):
+    ln = first(ins, slot)
+    if ln is None:
+        return jnp.full((x.shape[0],), x.shape[time_axis], jnp.int32)
+    return ln.astype(jnp.int32).reshape(-1)
+
+
+def _time_mask(x, lengths, time_axis=1):
+    """Boolean mask [B, T] broadcastable against x."""
+    t = x.shape[time_axis]
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    extra = x.ndim - 2
+    return mask.reshape(mask.shape + (1,) * extra)
+
+
+@register_op('sequence_pool')
+def _sequence_pool(ctx, ins, attrs):
+    x = first(ins, 'X')  # [B, T, ...]
+    lengths = _lengths(ins, 'XLen', x)
+    ptype = attrs.get('pooltype', attrs.get('pool_type', 'AVERAGE')).upper()
+    mask = _time_mask(x, lengths)
+    xf = x.astype(jnp.float32)
+    lf = jnp.maximum(lengths.astype(jnp.float32), 1.0)
+    lf = lf.reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == 'SUM':
+        y = jnp.sum(jnp.where(mask, xf, 0.0), axis=1)
+    elif ptype == 'AVERAGE':
+        y = jnp.sum(jnp.where(mask, xf, 0.0), axis=1) / lf
+    elif ptype == 'SQRT':
+        y = jnp.sum(jnp.where(mask, xf, 0.0), axis=1) / jnp.sqrt(lf)
+    elif ptype == 'MAX':
+        y = jnp.max(jnp.where(mask, xf, -jnp.inf), axis=1)
+    elif ptype == 'LAST':
+        idx = jnp.maximum(lengths - 1, 0)
+        y = jnp.take_along_axis(
+            xf, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)
+        y = y.squeeze(1)
+    elif ptype == 'FIRST':
+        y = xf[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    return out(y.astype(x.dtype))
+
+
+@register_op('sequence_softmax')
+def _sequence_softmax(ctx, ins, attrs):
+    """Softmax over the valid time steps of each row.  Accepts [B, T] or
+    [B, T, 1] (parity: operators/sequence_softmax_op)."""
+    x = first(ins, 'X')
+    lengths = _lengths(ins, 'XLen', x)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xs = x[..., 0] if squeeze else x
+    mask = jnp.arange(xs.shape[1])[None, :] < lengths[:, None]
+    logits = jnp.where(mask, xs.astype(jnp.float32), -jnp.inf)
+    y = jax.nn.softmax(logits, axis=1)
+    y = jnp.where(mask, y, 0.0).astype(x.dtype)
+    return out(y[..., None] if squeeze else y)
+
+
+@register_op('sequence_conv')
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (operators/sequence_conv_op):
+    each output step sees [context_start, context_start+context_length)
+    neighbouring steps, flattened, times Filter [ctx_len*D, M].  Lowered to
+    one MXU matmul over gathered context frames."""
+    x = first(ins, 'X')  # [B, T, D]
+    w = first(ins, 'Filter')  # [ctx_len*D, M]
+    lengths = _lengths(ins, 'XLen', x)
+    ctx_len = attrs.get('contextLength', attrs.get('context_length', 3))
+    ctx_start = attrs.get('contextStart', attrs.get('context_start',
+                                                    -(ctx_len // 2)))
+    b, t, d = x.shape
+    mask = _time_mask(x, lengths)
+    xm = jnp.where(mask, x.astype(jnp.float32), 0.0)
+    frames = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        idx = jnp.arange(t) + off
+        valid = ((idx >= 0) & (idx < t))[None, :, None]
+        # also invalid past each row's length
+        valid = valid & (idx[None, :, None] < lengths[:, None, None])
+        frames.append(jnp.where(valid, shifted, 0.0))
+    ctx_frames = jnp.concatenate(frames, axis=-1)  # [B, T, ctx_len*D]
+    y = jnp.einsum('btc,cm->btm', ctx_frames, w.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    y = jnp.where(mask, y, 0.0)
+    return out(y.astype(x.dtype))
+
+
+@register_op('sequence_expand')
+def _sequence_expand(ctx, ins, attrs):
+    """Expand per-sequence rows over Y's time dimension
+    (operators/sequence_expand_op): X [B, D] (one row per sequence) →
+    [B, Ty, D] masked to Y's lengths."""
+    x = first(ins, 'X')
+    y = first(ins, 'Y')
+    ylen = _lengths(ins, 'YLen', y)
+    ty = y.shape[1]
+    if x.ndim == 2:
+        expanded = jnp.broadcast_to(x[:, None, :],
+                                    (x.shape[0], ty, x.shape[1]))
+    else:
+        expanded = jnp.broadcast_to(x[:, None, ...],
+                                    (x.shape[0], ty) + x.shape[1:])
+    mask = jnp.arange(ty)[None, :] < ylen[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (expanded.ndim - 2))
+    return out(jnp.where(mask, expanded, jnp.zeros_like(expanded)))
+
+
+@register_op('sequence_concat')
+def _sequence_concat(ctx, ins, attrs):
+    """Concatenate two ragged batches along time (axis=1 repacking —
+    operators/sequence_concat_op with axis=0 level=0 semantics)."""
+    xs = ins['X']
+    lens = ins.get('XLen')
+    if lens is None or len(lens) != len(xs):
+        lens = [jnp.full((x.shape[0],), x.shape[1], jnp.int32) for x in xs]
+    acc = xs[0]
+    acc_len = lens[0].astype(jnp.int32).reshape(-1)
+    total_t = sum(x.shape[1] for x in xs)
+    pad_spec = [(0, 0)] * acc.ndim
+    pad_spec[1] = (0, total_t - acc.shape[1])
+    acc = jnp.pad(acc, pad_spec)
+    for x, ln in zip(xs[1:], lens[1:]):
+        ln = ln.astype(jnp.int32).reshape(-1)
+
+        def place(row_acc, row_x, start):
+            start_idx = (start,) + (0,) * (row_acc.ndim - 1)
+            return jax.lax.dynamic_update_slice(
+                row_acc, row_x.astype(row_acc.dtype), start_idx)
+
+        acc = jax.vmap(place)(acc, x, acc_len)
+        acc_len = acc_len + ln
+    mask = jnp.arange(acc.shape[1])[None, :] < acc_len[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (acc.ndim - 2))
+    acc = jnp.where(mask, acc, jnp.zeros_like(acc))
+    return {'Out': [acc], 'OutLen': [acc_len]}
+
+
+@register_op('sequence_slice')
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row slice [offset, offset+length) (operators/
+    sequence_slice_op)."""
+    x = first(ins, 'X')
+    offset = first(ins, 'Offset').astype(jnp.int32).reshape(-1)
+    length = first(ins, 'Length').astype(jnp.int32).reshape(-1)
+    max_len = int(attrs.get('max_length', x.shape[1]))
+
+    def slice_row(row, off):
+        start = (off,) + (0,) * (row.ndim - 1)
+        sizes = (max_len,) + row.shape[1:]
+        padded = jnp.pad(row, [(0, max_len)] + [(0, 0)] * (row.ndim - 1))
+        return jax.lax.dynamic_slice(padded, start, sizes)
+
+    y = jax.vmap(slice_row)(x, offset)
+    mask = jnp.arange(max_len)[None, :] < length[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (y.ndim - 2))
+    y = jnp.where(mask, y, jnp.zeros_like(y))
+    return {'Out': [y], 'OutLen': [length]}
+
+
+@register_op('sequence_erase')
+def _sequence_erase(ctx, ins, attrs):
+    """Remove tokens in `tokens` and compact left (operators/
+    sequence_erase_op)."""
+    x = first(ins, 'X')  # [B, T] int tokens
+    lengths = _lengths(ins, 'XLen', x)
+    tokens = jnp.asarray(attrs.get('tokens', []), jnp.int32)
+    t = x.shape[1]
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    erase = jnp.isin(x.astype(jnp.int32), tokens) & valid
+    keep = valid & ~erase
+    # stable partition: keys push erased/padding to the right
+    keys = jnp.where(keep, jnp.arange(t)[None, :], t + jnp.arange(t))
+    order = jnp.argsort(keys, axis=1)
+    y = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    pad_mask = jnp.arange(t)[None, :] < new_len[:, None]
+    y = jnp.where(pad_mask, y, jnp.zeros_like(y))
+    return {'Out': [y], 'OutLen': [new_len]}
+
+
+@register_op('lod_reset')
+def _lod_reset(ctx, ins, attrs):
+    x = first(ins, 'X')
+    target = first(ins, 'Y')
+    if target is None:
+        target = jnp.asarray(attrs['target_lod'], jnp.int32)
+    return {'Out': [x], 'OutLen': [target.astype(jnp.int32).reshape(-1)]}
+
+
+@register_op('max_sequence_len')
+def _max_sequence_len(ctx, ins, attrs):
+    x = first(ins, 'RankTable')
+    return out(jnp.max(x.astype(jnp.int32)).reshape((1,)))
+
+
+@register_op('sequence_first_step')
+def _sequence_first_step(ctx, ins, attrs):
+    return _pool_shim(ctx, ins, 'FIRST')
+
+
+@register_op('sequence_last_step')
+def _sequence_last_step(ctx, ins, attrs):
+    return _pool_shim(ctx, ins, 'LAST')
+
+
+def _pool_shim(ctx, ins, ptype):
+    from ..core.registry import get_op_impl
+    return get_op_impl('sequence_pool').compute(ctx, ins,
+                                                {'pooltype': ptype})

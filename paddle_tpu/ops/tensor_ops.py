@@ -1,0 +1,258 @@
+"""Tensor-manipulation ops.
+
+Reference parity: paddle/operators/{reshape,transpose,concat,split,expand,
+pad,crop,cast,assign,fill_*,gather,scatter,multiplex,one_hot,increment,
+compare,logical}_op.* — all pure jnp/lax; static shapes for XLA.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import datatypes
+from ..core.registry import register_op
+from .common import first, out
+
+
+@register_op('reshape')
+def _reshape(ctx, ins, attrs):
+    x = first(ins, 'X')
+    shape = list(attrs['shape'])
+    # fluid semantics: 0 means "copy this dim from x", -1 infers
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = x.shape[i]
+    return out(x.reshape(shape))
+
+
+@register_op('transpose')
+def _transpose(ctx, ins, attrs):
+    return out(jnp.transpose(first(ins, 'X'), attrs['axis']))
+
+
+@register_op('concat')
+def _concat(ctx, ins, attrs):
+    return out(jnp.concatenate(ins['X'], axis=attrs.get('axis', 0)))
+
+
+@register_op('split')
+def _split(ctx, ins, attrs):
+    x = first(ins, 'X')
+    axis = attrs.get('axis', 0)
+    if attrs.get('sections'):
+        idx = np.cumsum(attrs['sections'])[:-1].tolist()
+        pieces = jnp.split(x, idx, axis=axis)
+    else:
+        pieces = jnp.split(x, attrs['num'], axis=axis)
+    return out_list(pieces)
+
+
+def out_list(pieces):
+    return {'Out': list(pieces)}
+
+
+@register_op('expand')
+def _expand(ctx, ins, attrs):
+    x = first(ins, 'X')
+    times = attrs['expand_times']
+    return out(jnp.tile(x, times))
+
+
+@register_op('pad')
+def _pad(ctx, ins, attrs):
+    x = first(ins, 'X')
+    p = attrs['paddings']
+    pad_width = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return out(jnp.pad(x, pad_width,
+                       constant_values=attrs.get('pad_value', 0.0)))
+
+
+@register_op('crop')
+def _crop(ctx, ins, attrs):
+    x = first(ins, 'X')
+    offsets = attrs['offsets']
+    shape = attrs['shape']
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return out(x[slices])
+
+
+@register_op('cast')
+def _cast(ctx, ins, attrs):
+    dtype = datatypes.as_numpy_dtype(attrs['out_dtype'])
+    if dtype == np.int64:
+        dtype = np.int32  # x64 disabled on TPU
+    elif dtype == np.float64:
+        dtype = np.float32
+    return out(first(ins, 'X').astype(dtype))
+
+
+@register_op('assign')
+def _assign(ctx, ins, attrs):
+    return out(first(ins, 'X'))
+
+
+@register_op('assign_value')
+def _assign_value(ctx, ins, attrs):
+    vals = np.array(attrs['values'],
+                    dtype=datatypes.as_numpy_dtype(attrs.get('dtype',
+                                                             'float32')))
+    return out(jnp.asarray(vals.reshape(attrs['shape'])))
+
+
+@register_op('fill_constant')
+def _fill_constant(ctx, ins, attrs):
+    dtype = datatypes.as_numpy_dtype(attrs.get('dtype', 'float32'))
+    if dtype == np.int64:
+        dtype = np.int32
+    elif dtype == np.float64:
+        dtype = np.float32
+    return out(jnp.full(tuple(attrs['shape']), attrs['value'], dtype=dtype))
+
+
+@register_op('fill')
+def _fill(ctx, ins, attrs):
+    dtype = datatypes.as_numpy_dtype(attrs.get('dtype', 'float32'))
+    data = np.array(attrs['value'], dtype=dtype).reshape(attrs['shape'])
+    return out(jnp.asarray(data))
+
+
+@register_op('fill_zeros_like')
+def _fill_zeros_like(ctx, ins, attrs):
+    return out(jnp.zeros_like(first(ins, 'X')))
+
+
+@register_op('fill_constant_batch_size_like')
+def _fill_cbsl(ctx, ins, attrs):
+    ref = first(ins, 'Input')
+    shape = list(attrs['shape'])
+    in_idx = attrs.get('input_dim_idx', 0)
+    out_idx = attrs.get('output_dim_idx', 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = datatypes.as_numpy_dtype(attrs.get('dtype', 'float32'))
+    if dtype == np.int64:
+        dtype = np.int32
+    return out(jnp.full(tuple(shape), attrs.get('value', 0.0), dtype=dtype))
+
+
+@register_op('gather')
+def _gather(ctx, ins, attrs):
+    x = first(ins, 'X')
+    index = first(ins, 'Index').astype(jnp.int32).reshape(-1)
+    return out(jnp.take(x, index, axis=0))
+
+
+@register_op('scatter')
+def _scatter(ctx, ins, attrs):
+    """Overwrite rows of X at Ids with Updates (operators/scatter_op)."""
+    x = first(ins, 'X')
+    ids = first(ins, 'Ids').astype(jnp.int32).reshape(-1)
+    upd = first(ins, 'Updates')
+    return out(x.at[ids].set(upd))
+
+
+@register_op('multiplex')
+def _multiplex(ctx, ins, attrs):
+    ids = first(ins, 'Ids').astype(jnp.int32).reshape(-1)
+    stack = jnp.stack(ins['X'], axis=0)  # [n_candidates, batch, ...]
+    batch = jnp.arange(stack.shape[1])
+    return out(stack[ids, batch])
+
+
+@register_op('one_hot')
+def _one_hot(ctx, ins, attrs):
+    x = first(ins, 'X').astype(jnp.int32)
+    depth = attrs['depth']
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    return out(jax.nn.one_hot(x, depth, dtype=jnp.float32))
+
+
+@register_op('increment')
+def _increment(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(x + jnp.asarray(attrs.get('step', 1.0), dtype=x.dtype))
+
+
+def _compare(name, fn):
+    @register_op(name)
+    def _impl(ctx, ins, attrs, _fn=fn):
+        x = first(ins, 'X')
+        y = first(ins, 'Y')
+        return out(_fn(x, y))
+
+    return _impl
+
+
+_compare('less_than', jnp.less)
+_compare('less_equal', jnp.less_equal)
+_compare('greater_than', jnp.greater)
+_compare('greater_equal', jnp.greater_equal)
+_compare('equal', jnp.equal)
+_compare('not_equal', jnp.not_equal)
+
+
+def _logical(name, fn, binary=True):
+    @register_op('logical_' + name)
+    def _impl(ctx, ins, attrs, _fn=fn, _b=binary):
+        x = first(ins, 'X')
+        if _b:
+            return out(_fn(x, first(ins, 'Y')))
+        return out(_fn(x))
+
+    return _impl
+
+
+_logical('and', jnp.logical_and)
+_logical('or', jnp.logical_or)
+_logical('xor', jnp.logical_xor)
+_logical('not', jnp.logical_not, binary=False)
+
+
+@register_op('is_empty')
+def _is_empty(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(jnp.asarray(x.size == 0))
+
+
+@register_op('sign_of')
+def _sign_of(ctx, ins, attrs):
+    return out(jnp.sign(first(ins, 'X')))
+
+
+@register_op('sequence_reshape')
+def _sequence_reshape(ctx, ins, attrs):
+    x = first(ins, 'X')
+    new_dim = attrs['new_dim']
+    return out(x.reshape(x.shape[0], -1, new_dim))
+
+
+@register_op('print')
+def _print(ctx, ins, attrs):
+    x = first(ins, 'X')
+    jax.debug.print(attrs.get('message', '') + " {}", x)
+    return out(x)
+
+
+@register_op('im2sequence')
+def _im2sequence(ctx, ins, attrs):
+    """Extract conv patches as a sequence (operators/im2sequence_op): output
+    [N, out_h*out_w, C*kh*kw] (padded-batch form of the reference's LoD
+    output)."""
+    x = first(ins, 'X')  # NCHW
+    kh, kw = attrs['kernels']
+    sh, sw = attrs.get('strides', [1, 1])
+    p = attrs.get('paddings', [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        [(p[0], p[2] if len(p) > 2 else p[0]),
+         (p[1], p[3] if len(p) > 3 else p[1])],
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    n, ckk, oh, ow = patches.shape
+    return out(patches.reshape(n, ckk, oh * ow).transpose(0, 2, 1))
+
+
+@register_op('select')
+def _select(ctx, ins, attrs):
+    """Elementwise where(Cond, X, Y)."""
+    cond = first(ins, 'Condition')
+    return out(jnp.where(cond.astype(bool), first(ins, 'X'),
+                         first(ins, 'Y')))

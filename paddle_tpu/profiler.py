@@ -1,0 +1,74 @@
+"""Profiler.
+
+Reference parity: python/paddle/v2/fluid/profiler.py (cuda_profiler,
+profiler context, reset_profiler) re-based on jax.profiler: traces are
+XLA/TPU traces viewable in TensorBoard/Perfetto instead of nvprof output.
+"""
+import contextlib
+import os
+import time
+
+import jax
+
+__all__ = ['profiler', 'cuda_profiler', 'reset_profiler', 'RecordEvent',
+           'start_profiler', 'stop_profiler']
+
+_events = []
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, log_dir='/tmp/paddle_tpu_prof'):
+    """Trace the enclosed region with the XLA profiler."""
+    os.makedirs(log_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        started = False
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+        _events.append(('profile_region', time.time() - t0))
+
+
+# The reference exposes cuda_profiler; on TPU it is the same XLA trace.
+cuda_profiler = profiler
+
+
+def start_profiler(state='All', log_dir='/tmp/paddle_tpu_prof'):
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    del _events[:]
+
+
+class RecordEvent(object):
+    """Named host-side timing region (parity with platform::RecordEvent);
+    also annotates device traces via jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        _events.append((self.name, time.time() - self._t0))
+        self._ann.__exit__(*exc)
+        return False
+
+
+def get_events():
+    return list(_events)
