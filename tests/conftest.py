@@ -1,0 +1,42 @@
+"""Test bootstrap: force a deterministic 8-virtual-device CPU platform so
+parallel tests (dp/tp/pp/sp over a Mesh) run without TPU hardware.
+
+Must run before jax initialises its backends, hence module scope here
+(pytest imports conftest before test modules import jax).
+"""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ.setdefault('PADDLE_TPU_SYNTH_DATA', '1')
+
+import jax  # noqa: E402
+
+# A sitecustomize hook in this image re-registers the TPU tunnel plugin and
+# resets JAX_PLATFORMS after the interpreter starts; the config API wins.
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + a fresh global scope, like the
+    reference's per-test Program() isolation."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.core import scope as scope_mod
+    main, startup = fluid.Program(), fluid.Program()
+    old_main = prog_mod.switch_main_program(main)
+    old_startup = prog_mod.switch_startup_program(startup)
+    old_scope = scope_mod._global_scope
+    scope_mod._global_scope = scope_mod.Scope()
+    np.random.seed(1234)
+    yield
+    prog_mod.switch_main_program(old_main)
+    prog_mod.switch_startup_program(old_startup)
+    scope_mod._global_scope = old_scope

@@ -1,0 +1,84 @@
+"""Activation op checks vs numpy (ref tests/test_activation_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _softplus(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+CASES = {
+    'sigmoid': lambda x: 1.0 / (1.0 + np.exp(-x)),
+    'logsigmoid': lambda x: -_softplus(-x),
+    'exp': np.exp,
+    'relu': lambda x: np.maximum(x, 0),
+    'tanh': np.tanh,
+    'sqrt': lambda x: np.sqrt(np.abs(x) + 1.0),
+    'abs': np.abs,
+    'ceil': np.ceil,
+    'floor': np.floor,
+    'round': np.round,
+    'reciprocal': lambda x: 1.0 / (x + 3.0),
+    'log': lambda x: np.log(np.abs(x) + 1.0),
+    'square': np.square,
+    'softplus': _softplus,
+    'softsign': lambda x: x / (1 + np.abs(x)),
+}
+
+
+def _make(op, fn):
+    class _T(OpTest):
+        op_type = op
+
+        def setup(self):
+            x = np.random.uniform(-1, 1, (4, 7)).astype('float32')
+            if op in ('sqrt', 'log'):
+                x = np.abs(x) + 1.0
+            elif op == 'reciprocal':
+                x = x + 3.0
+            self.inputs = {'X': x}
+            self.outputs = {'Out': fn(x) if op not in (
+                'sqrt', 'log', 'reciprocal') else {
+                'sqrt': np.sqrt, 'log': np.log,
+                'reciprocal': lambda v: 1.0 / v}[op](x)}
+    return _T
+
+
+def test_forward_all():
+    for op, fn in CASES.items():
+        t = _make(op, fn)()
+        t.setup()
+        t.check_output(atol=1e-4, rtol=1e-3)
+
+
+def test_grads_smooth():
+    for op in ['sigmoid', 'tanh', 'exp', 'square', 'softplus', 'softsign']:
+        t = _make(op, CASES[op])()
+        t.setup()
+        t.check_grad(['X'])
+
+
+def test_parametric():
+    x = np.random.uniform(-2, 2, (3, 5)).astype('float32')
+    cases = [
+        ('leaky_relu', {'alpha': 0.1}, np.where(x > 0, x, 0.1 * x)),
+        ('elu', {'alpha': 1.0}, np.where(x > 0, x, np.expm1(x))),
+        ('relu6', {'threshold': 6.0}, np.clip(x, 0, 6)),
+        ('pow', {'factor': 2.0}, np.power(x, 2.0)),
+        ('brelu', {'t_min': -0.5, 't_max': 0.5}, np.clip(x, -0.5, 0.5)),
+        ('hard_sigmoid', {'slope': 0.2, 'offset': 0.5},
+         np.clip(0.2 * x + 0.5, 0, 1)),
+        ('swish', {'beta': 1.0}, x / (1 + np.exp(-x))),
+        ('stanh', {'scale_a': 2.0 / 3, 'scale_b': 1.7159},
+         1.7159 * np.tanh(2.0 / 3 * x)),
+        ('hard_shrink', {'threshold': 0.5}, np.where(np.abs(x) > 0.5, x, 0)),
+        ('softshrink', {'lambda': 0.5},
+         np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0))),
+        ('thresholded_relu', {'threshold': 1.0}, np.where(x > 1.0, x, 0)),
+    ]
+    for op, attrs, expected in cases:
+        t = type('T', (OpTest,), dict(op_type=op, attrs=attrs))()
+        t.inputs = {'X': x}
+        t.outputs = {'Out': expected.astype('float32')}
+        t.check_output(atol=1e-5)

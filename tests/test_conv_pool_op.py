@@ -1,0 +1,95 @@
+"""conv2d / pool2d / conv2d_transpose checks vs torch-free numpy refs
+(ref tests/test_conv2d_op.py, test_pool2d_op.py)."""
+import numpy as np
+
+from op_test import run_op
+
+
+def _conv2d_ref(x, w, stride, pad, groups=1):
+    n, cin, h, ww = x.shape
+    cout, cin_g, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (ww + 2 * pad - kw) // stride + 1
+    y = np.zeros((n, cout, oh, ow), dtype=np.float64)
+    cpg = cout // groups
+    for g in range(groups):
+        for oc in range(g * cpg, (g + 1) * cpg):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[:, g * cin_g:(g + 1) * cin_g,
+                               i * stride:i * stride + kh,
+                               j * stride:j * stride + kw]
+                    y[:, oc, i, j] = (patch * w[oc]).sum(axis=(1, 2, 3))
+    return y
+
+
+def test_conv2d_basic():
+    x = np.random.rand(2, 3, 8, 8).astype('float32')
+    w = np.random.rand(4, 3, 3, 3).astype('float32')
+    o = run_op('conv2d', {'Input': x, 'Filter': w},
+               {'strides': [1, 1], 'paddings': [1, 1], 'groups': 1,
+                'dilations': [1, 1]})['Output'][0]
+    np.testing.assert_allclose(np.asarray(o), _conv2d_ref(x, w, 1, 1),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_conv2d_stride_groups():
+    x = np.random.rand(1, 4, 9, 9).astype('float32')
+    w = np.random.rand(6, 2, 3, 3).astype('float32')
+    o = run_op('conv2d', {'Input': x, 'Filter': w},
+               {'strides': [2, 2], 'paddings': [0, 0], 'groups': 2,
+                'dilations': [1, 1]})['Output'][0]
+    np.testing.assert_allclose(np.asarray(o),
+                               _conv2d_ref(x, w, 2, 0, groups=2),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_pool2d_max_avg():
+    x = np.random.rand(2, 3, 8, 8).astype('float32')
+    o = run_op('pool2d', {'X': x},
+               {'pooling_type': 'max', 'ksize': [2, 2], 'strides': [2, 2],
+                'paddings': [0, 0]})['Out'][0]
+    ref = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-5)
+
+    o = run_op('pool2d', {'X': x},
+               {'pooling_type': 'avg', 'ksize': [2, 2], 'strides': [2, 2],
+                'paddings': [0, 0]})['Out'][0]
+    ref = x.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-5)
+
+
+def test_pool2d_global():
+    x = np.random.rand(2, 3, 5, 5).astype('float32')
+    o = run_op('pool2d', {'X': x},
+               {'pooling_type': 'avg', 'global_pooling': True,
+                'ksize': [1, 1], 'strides': [1, 1],
+                'paddings': [0, 0]})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o).squeeze(),
+                               x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_conv2d_transpose_shape():
+    x = np.random.rand(1, 4, 5, 5).astype('float32')
+    w = np.random.rand(4, 3, 4, 4).astype('float32')  # [Cin, Cout, kh, kw]
+    o = run_op('conv2d_transpose', {'Input': x, 'Filter': w},
+               {'strides': [2, 2], 'paddings': [1, 1],
+                'dilations': [1, 1]})['Output'][0]
+    assert o.shape == (1, 3, 10, 10)
+
+
+def test_max_pool_with_index_roundtrip():
+    x = np.random.rand(1, 2, 4, 4).astype('float32')
+    outs = run_op('max_pool2d_with_index', {'X': x},
+                  {'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]})
+    vals = np.asarray(outs['Out'][0])
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(vals, ref, rtol=1e-5)
+    up = run_op('unpool', {'X': outs['Out'][0], 'Indices': outs['Mask'][0]},
+                {'ksize': [2, 2], 'strides': [2, 2],
+                 'unpooling_type': 'max', 'unpooled_height': 4,
+                 'unpooled_width': 4})['Out'][0]
+    assert up.shape == x.shape
+    # every pooled max value must land back somewhere in its window
+    assert np.allclose(np.asarray(up).sum(), vals.sum(), rtol=1e-5)

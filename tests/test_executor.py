@@ -1,0 +1,82 @@
+"""Executor end-to-end tests: feed/fetch, whole-block jit caching, training
+convergence, rng determinism (ref tests/test_executor_and_mul.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build_linreg():
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.square_error_cost(input=pred, label=y)
+    avg = fluid.layers.mean(x=cost)
+    return pred, avg
+
+
+def test_feed_fetch_mul():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    y = fluid.layers.fc(input=x, size=2, bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(5, 3).astype('float32')
+    out, = exe.run(feed={'x': xv}, fetch_list=[y])
+    w_name = [v.name for v in fluid.default_main_program().list_vars()
+              if isinstance(v, fluid.Parameter)][0]
+    w = fluid.global_scope().get_numpy(w_name)
+    np.testing.assert_allclose(out, xv @ w, rtol=1e-4)
+
+
+def test_training_reduces_loss():
+    pred, avg = _build_linreg()
+    opt = fluid.optimizer.SGD(learning_rate=0.02)
+    opt.minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    W = rng.randn(13, 1).astype('float32')
+    losses = []
+    for _ in range(60):
+        xb = rng.randn(32, 13).astype('float32')
+        loss, = exe.run(feed={'x': xb, 'y': xb @ W}, fetch_list=[avg])
+        losses.append(float(np.asarray(loss).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_adam_training():
+    pred, avg = _build_linreg()
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    W = rng.randn(13, 1).astype('float32')
+    losses = []
+    for _ in range(60):
+        xb = rng.randn(32, 13).astype('float32')
+        loss, = exe.run(feed={'x': xb, 'y': xb @ W}, fetch_list=[avg])
+        losses.append(float(np.asarray(loss).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_fetch_variable_and_name():
+    x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+    y = fluid.layers.scale(x=x, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 2), 'float32')
+    a, b = exe.run(feed={'x': xv}, fetch_list=[y, y.name])
+    np.testing.assert_allclose(a, 3 * xv)
+    np.testing.assert_allclose(b, 3 * xv)
+
+
+def test_dropout_train_vs_test():
+    x = fluid.layers.data(name='x', shape=[100], dtype='float32')
+    d = fluid.layers.dropout(x=x, dropout_prob=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((4, 100), 'float32')
+    out, = exe.run(feed={'x': xv}, fetch_list=[d])
+    frac = (np.asarray(out) == 0).mean()
+    assert 0.25 < frac < 0.75  # roughly half dropped
+
+    test_prog = fluid.default_main_program().inference_optimize()
+    out2, = exe.run(test_prog, feed={'x': xv}, fetch_list=[d.name])
+    np.testing.assert_allclose(out2, xv)  # no dropout at inference

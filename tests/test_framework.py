@@ -1,0 +1,96 @@
+"""Program/Block/Variable/scope framework tests
+(ref tests/test_program.py, test_variable.py, test_scope.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.program import Program
+
+
+def test_program_append_and_vars():
+    prog = Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.fc(input=x, size=4)
+    block = prog.global_block()
+    assert any(op.type == 'mul' for op in block.ops)
+    assert x.name in block.vars and y.name in block.vars
+    params = [v for v in prog.list_vars()
+              if isinstance(v, fluid.Parameter)]
+    assert len(params) == 2  # weight + bias
+
+
+def test_default_programs_and_guard():
+    main0 = fluid.default_main_program()
+    p = Program()
+    with fluid.program_guard(p):
+        assert fluid.default_main_program() is p
+    assert fluid.default_main_program() is main0
+
+
+def test_program_clone_independent():
+    prog = Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.fc(input=x, size=2)
+    c = prog.clone()
+    n_ops = len(prog.global_block().ops)
+    with fluid.program_guard(c):
+        fluid.layers.fc(input=c.global_block().var(x.name), size=3)
+    assert len(prog.global_block().ops) == n_ops
+    assert len(c.global_block().ops) > n_ops
+
+
+def test_unique_name():
+    a = fluid.unique_name('fc')
+    b = fluid.unique_name('fc')
+    assert a != b
+
+
+def test_scope_basics():
+    s = fluid.Scope()
+    s.set('w', np.ones((2, 2)))
+    assert s.has('w')
+    child = s.new_scope()
+    assert child.has('w')
+    np.testing.assert_array_equal(child.get_numpy('w'), np.ones((2, 2)))
+    child.set('b', np.zeros(3))
+    assert not s.has('b')
+    with pytest.raises(KeyError):
+        s.get('b')
+
+
+def test_program_serialization_roundtrip():
+    prog = Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data(name='x', shape=[5], dtype='float32')
+        fluid.layers.fc(input=x, size=3, act='relu')
+    js = prog.to_json()
+    prog2 = Program.from_json(js)
+    assert [op.type for op in prog2.global_block().ops] == \
+           [op.type for op in prog.global_block().ops]
+    assert sorted(prog2.global_block().vars) == \
+           sorted(prog.global_block().vars)
+
+
+def test_stop_gradient_blocks_grad():
+    prog, startup = Program(), Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=4, act=None)
+        h.stop_gradient = True
+        y = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(x=y)
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    first_w = None
+    for v in prog.list_vars():
+        if isinstance(v, fluid.Parameter):
+            first_w = first_w or v.name
+    before = fluid.global_scope().get_numpy(first_w)
+    exe.run(prog, feed={'x': np.ones((3, 4), 'float32')}, fetch_list=[loss])
+    after = fluid.global_scope().get_numpy(first_w)
+    # first fc is upstream of stop_gradient → unchanged
+    np.testing.assert_array_equal(before, after)

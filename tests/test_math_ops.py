@@ -1,0 +1,107 @@
+"""matmul / mul / elementwise / reduce / softmax op checks
+(ref tests/test_{mul,matmul,elementwise_*,reduce,softmax}_op.py)."""
+import numpy as np
+
+from op_test import OpTest, run_op
+
+
+def test_mul_2d():
+    x = np.random.rand(4, 5).astype('float32')
+    y = np.random.rand(5, 3).astype('float32')
+    t = type('T', (OpTest,), dict(op_type='mul'))()
+    t.inputs = {'X': x, 'Y': y}
+    t.outputs = {'Out': x @ y}
+    t.check_output()
+    t.check_grad(['X', 'Y'])
+
+
+def test_mul_num_col_dims():
+    x = np.random.rand(2, 3, 4).astype('float32')
+    y = np.random.rand(4, 6).astype('float32')
+    o = run_op('mul', {'X': x, 'Y': y}, {'x_num_col_dims': 2})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o),
+                               (x.reshape(6, 4) @ y).reshape(2, 3, 6),
+                               rtol=1e-5)
+
+
+def test_matmul_transpose():
+    x = np.random.rand(3, 4).astype('float32')
+    y = np.random.rand(5, 4).astype('float32')
+    o = run_op('matmul', {'X': x, 'Y': y}, {'transpose_Y': True})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), x @ y.T, rtol=1e-5)
+
+
+def test_matmul_batched():
+    x = np.random.rand(2, 3, 4).astype('float32')
+    y = np.random.rand(2, 4, 5).astype('float32')
+    o = run_op('matmul', {'X': x, 'Y': y})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), x @ y, rtol=1e-5)
+
+
+def test_elementwise_broadcast_axis():
+    x = np.random.rand(2, 3, 4, 5).astype('float32')
+    y = np.random.rand(3, 4).astype('float32')
+    o = run_op('elementwise_add', {'X': x, 'Y': y}, {'axis': 1})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), x + y.reshape(1, 3, 4, 1),
+                               rtol=1e-5)
+
+
+def test_elementwise_all():
+    x = np.random.rand(4, 5).astype('float32') + 1.0
+    y = np.random.rand(4, 5).astype('float32') + 1.0
+    for name, fn in [('add', np.add), ('sub', np.subtract),
+                     ('mul', np.multiply), ('div', np.divide),
+                     ('max', np.maximum), ('min', np.minimum),
+                     ('pow', np.power)]:
+        o = run_op('elementwise_' + name, {'X': x, 'Y': y})['Out'][0]
+        np.testing.assert_allclose(np.asarray(o), fn(x, y), rtol=1e-4)
+
+
+def test_reduce_ops():
+    x = np.random.rand(3, 4, 5).astype('float32')
+    for name, fn in [('sum', np.sum), ('mean', np.mean), ('max', np.max),
+                     ('min', np.min)]:
+        o = run_op('reduce_' + name, {'X': x}, {'dim': 1})['Out'][0]
+        np.testing.assert_allclose(np.asarray(o), fn(x, axis=1), rtol=1e-5)
+    o = run_op('reduce_sum', {'X': x}, {'keep_dim': True, 'dim': 2})['Out'][0]
+    assert o.shape == (3, 4, 1)
+
+
+def test_softmax():
+    x = np.random.rand(6, 10).astype('float32')
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    t = type('T', (OpTest,), dict(op_type='softmax'))()
+    t.inputs = {'X': x}
+    t.outputs = {'Out': e / e.sum(axis=1, keepdims=True)}
+    t.check_output()
+    t.check_grad(['X'])
+
+
+def test_scale_sum_mean_clip():
+    x = np.random.rand(3, 4).astype('float32')
+    o = run_op('scale', {'X': x}, {'scale': 2.0, 'bias': 1.0})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), 2 * x + 1, rtol=1e-6)
+    o = run_op('sum', {'X': [x, x, x]})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), 3 * x, rtol=1e-6)
+    o = run_op('mean', {'X': x})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), [x.mean()], rtol=1e-6)
+    o = run_op('clip', {'X': x}, {'min': 0.2, 'max': 0.8})['Out'][0]
+    np.testing.assert_allclose(np.asarray(o), np.clip(x, 0.2, 0.8))
+
+
+def test_top_k():
+    x = np.random.rand(4, 10).astype('float32')
+    outs = run_op('top_k', {'X': x}, {'k': 3})
+    vals, idxs = np.asarray(outs['Out'][0]), np.asarray(outs['Indices'][0])
+    ref_idx = np.argsort(-x, axis=1)[:, :3]
+    np.testing.assert_array_equal(idxs, ref_idx)
+    np.testing.assert_allclose(vals, np.take_along_axis(x, ref_idx, axis=1))
+
+
+def test_cos_sim():
+    x = np.random.rand(4, 6).astype('float32')
+    y = np.random.rand(4, 6).astype('float32')
+    o = np.asarray(run_op('cos_sim', {'X': x, 'Y': y})['Out'][0])
+    ref = (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
+                            np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(o.ravel(), ref, rtol=1e-4)
