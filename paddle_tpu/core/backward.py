@@ -48,19 +48,32 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             g = block.var(gn)
         params_and_grads.append((p, g))
 
-    fwd_end = len(block.ops)
     block.append_op(
         type='autodiff',
         inputs={'Loss': [loss]},
         outputs={'Grads': grad_names},
         attrs={
-            'forward_start': 0,
-            'forward_end': fwd_end,
             'loss_name': loss.name,
             'param_names': param_names,
             'grad_names': grad_names,
             'loss_scale': 1.0,
+            'op_role': 'backward',
         })
+    # Note: fluid's error_clip is applied here via callbacks weaving clip ops
+    # into the grad-op chain.  In this framework a var's `error_clip` is read
+    # directly by the executor, which wraps the var's forward value in a
+    # clip-cotangent identity inside the autodiff closure (executor._run_one)
+    # — same semantics, no grad-op weaving.  Custom callbacks still fire once
+    # per (param, grad) for API parity.
+    if callbacks:
+        from ..clip import error_clip_callback
+        for cb in (callbacks if isinstance(callbacks, (list, tuple))
+                   else [callbacks]):
+            if cb is error_clip_callback:
+                continue  # handled natively (see note above)
+            with program.op_role_guard('backward'):
+                for p, g in params_and_grads:
+                    cb(block, {'param': p, 'grad': g})
     return params_and_grads
 
 
@@ -88,11 +101,10 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
         inputs={'Loss': [loss]},
         outputs={'Grads': grad_names},
         attrs={
-            'forward_start': 0,
-            'forward_end': len(block.ops),
             'loss_name': loss.name,
             'param_names': in_names,
             'grad_names': grad_names,
             'loss_scale': 1.0,
+            'op_role': 'backward',
         })
     return grads

@@ -62,7 +62,30 @@ class ExecutionContext(object):
         return env
 
 
-def _run_one(op, env, ctx, op_index):
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _clip_cotangent(x, lo, hi):
+    """Identity whose backward clips the incoming gradient — the TPU-native
+    realisation of fluid's ErrorClipByValue (clip.py error_clip_callback):
+    instead of weaving a clip op into the grad-op chain, the clip rides the
+    VJP of the var it guards."""
+    return x
+
+
+def _cc_fwd(x, lo, hi):
+    return x, None
+
+
+def _cc_bwd(lo, hi, _res, g):
+    return (jnp.clip(g, lo, hi),)
+
+
+_clip_cotangent.defvjp(_cc_fwd, _cc_bwd)
+
+
+def _run_one(op, env, ctx, op_index, frozen=()):
     impl = get_op_impl(op.type)
     ins = {}
     for slot, names in op.inputs.items():
@@ -81,56 +104,131 @@ def _run_one(op, env, ctx, op_index):
         for n, v in zip(names, vals):
             if v is None:
                 continue
+            if n in frozen:
+                # `n` is a differentiation point (calc_gradient wrt an
+                # intermediate var): keep the injected leaf value so grads
+                # attach to it rather than to its producer.
+                continue
             try:
                 var = ctx.block.var_recursive(n)
                 if var.stop_gradient and not var.is_data:
                     v = jax.lax.stop_gradient(v)
+                ec = getattr(var, 'error_clip', None)
+                if ec is not None:
+                    v = _clip_cotangent(v, float(ec.min), float(ec.max))
             except KeyError:
                 pass
             env[n] = v
 
 
+def _op_role(op):
+    return op.attrs.get('op_role', 'forward')
+
+
+def _tainted_slice(ops, k, param_names, ad_idxs):
+    """Forward-role ops before index k on the dependency path from
+    `param_names` to anything downstream (forward taint propagation)."""
+    tainted = set(param_names)
+    picked = []
+    for j in range(k):
+        if j in ad_idxs or _op_role(ops[j]) != 'forward':
+            continue
+        if set(ops[j].input_arg_names) & tainted:
+            picked.append((j, ops[j]))
+            tainted.update(ops[j].output_arg_names)
+    return picked
+
+
 def _run_ops(ops, env, ctx):
-    """Interpret a list of ops.  `autodiff` ops (appended by
-    core/backward.py) are handled here: the forward range they cover is
-    executed exactly once, inside jax.value_and_grad — functional autodiff
-    replacing the reference's per-op grad kernels (framework/backward.cc)."""
+    """Interpret a list of ops with fluid program-order semantics.
+
+    `autodiff` ops (appended by core/backward.py) replace the reference's
+    per-op grad weaving (framework/backward.cc) with jax.value_and_grad:
+
+    - The FIRST autodiff executes every preceding forward-role op inside its
+      closure (one fused fwd+bwd HLO — the hot path for normal training) and
+      publishes their outputs.  Exact, because no optimizer update precedes
+      it.
+    - LATER autodiff ops (multi-minimize programs: GAN, multi-loss) re-run
+      only the subgraph tainted by their params, from a snapshot in which
+      any already-applied optimizer updates are rolled back — so every
+      gradient is taken at the values the single program-order forward saw,
+      matching the reference executor exactly.
+    - backward/optimize-role ops (grad clip, regularizers, sgd/adam, LR
+      schedules) run at top level in program order.
+    """
     ad_idxs = [i for i, op in enumerate(ops) if op.type == 'autodiff']
-    cursor = 0
-    for k in ad_idxs:
-        ad_op = ops[k]
-        s = ad_op.attrs['forward_start']
-        for i in range(cursor, s):
-            _run_one(ops[i], env, ctx, i)
-        _run_autodiff(ad_op, ops[s:k], env, ctx, base_index=s)
-        cursor = k + 1
-    for i in range(cursor, len(ops)):
-        _run_one(ops[i], env, ctx, i)
+    first_ad = ad_idxs[0] if ad_idxs else None
+    c1 = set()
+    if first_ad is not None:
+        c1 = {j for j in range(first_ad)
+              if j not in ad_idxs and _op_role(ops[j]) == 'forward'}
+    pre_update_vals = {}  # param name -> value before its first update
+    for i, op in enumerate(ops):
+        if op.type == 'autodiff':
+            if i == first_ad:
+                fwd = [(j, ops[j]) for j in sorted(c1)]
+                _run_autodiff(op, fwd, env, ctx, {}, publish=True)
+            else:
+                fwd = _tainted_slice(ops, i, op.attrs['param_names'],
+                                     set(ad_idxs))
+                _run_autodiff(op, fwd, env, ctx, pre_update_vals,
+                              publish=False)
+        elif i in c1:
+            continue  # runs inside the first autodiff closure
+        else:
+            if _op_role(op) == 'optimize':
+                for n in op.output_arg_names:
+                    if n in env and n not in pre_update_vals:
+                        pre_update_vals[n] = env[n]
+            _run_one(op, env, ctx, i)
 
 
-def _run_autodiff(ad_op, fwd_ops, env, ctx, base_index):
+def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
+    """fwd_ops: [(original_index, op)] forward slice for this autodiff."""
     param_names = list(ad_op.attrs['param_names'])
     grad_names = list(ad_op.attrs['grad_names'])
     loss_name = ad_op.attrs['loss_name']
     loss_scale = ad_op.attrs.get('loss_scale', 1.0)
 
-    params = {n: env[n] for n in param_names}
     captured = dict(env)
+    captured.update(pre_update_vals)
+    written = set()
+    for _, op in fwd_ops:
+        written.update(op.output_arg_names)
+    frozen = frozenset(set(param_names) & written)
+
+    if any(n not in captured for n in param_names):
+        # calc_gradient wrt an intermediate var: materialise its value with
+        # one plain forward pass (XLA CSEs this against the grad pass).
+        env_pre = dict(captured)
+        for j, op in fwd_ops:
+            _run_one(op, env_pre, ctx, j)
+        for n in param_names:
+            if n not in captured:
+                captured[n] = env_pre[n]
+                env[n] = env_pre[n]
+    params = {n: captured[n] for n in param_names}
 
     def f(ps):
         env2 = dict(captured)
         env2.update(ps)
-        for j, op in enumerate(fwd_ops):
-            _run_one(op, env2, ctx, base_index + j)
+        for j, op in fwd_ops:
+            _run_one(op, env2, ctx, j, frozen)
         loss = env2[loss_name]
         loss = jnp.sum(loss.astype(jnp.float32)) * loss_scale
         return loss, env2
 
     (_, env_fwd), grads = jax.value_and_grad(f, has_aux=True)(params)
-    env.update(env_fwd)
+    if publish:
+        for n in written:
+            if n in env_fwd:
+                env[n] = env_fwd[n]
+        if loss_name not in written and loss_name in env_fwd:
+            env[loss_name] = env_fwd[loss_name]
     for pn, gn in zip(param_names, grad_names):
         g = grads[pn]
-        env[gn] = g.astype(env[pn].dtype) if hasattr(g, 'astype') else g
+        env[gn] = g.astype(params[pn].dtype) if hasattr(g, 'astype') else g
 
 
 def _to_feed_arrays(name, value, var):
@@ -273,6 +371,17 @@ class Executor(object):
                state_rw_names, state_ro_names, state_out_names, id(scope))
         if use_cache and key in self._cache:
             return self._cache[key]
+
+        known = set()
+        for b in program.blocks:
+            known.update(b.vars)
+            for op in b.ops:
+                known.update(op.output_arg_names)
+        for n in fetch_names:
+            if n not in known and n not in feed_arrays:
+                raise KeyError(
+                    "fetch var %r is not produced by any op in the program "
+                    "and is not fed" % n)
 
         prog = program
 

@@ -29,8 +29,14 @@ class LoDTensor(object):
             # kept for API parity.
             self._rec_lens = [list(l) for l in recursive_seq_lens]
             self._lengths = list(self._rec_lens[-1])
-            flat = np.asarray(data)
-            self._flat = flat
+            total = sum(self._lengths)
+            if isinstance(data, (list, tuple)) and len(data) and \
+                    not np.isscalar(data[0]) and len(data) != total and \
+                    sum(len(s) for s in data) == total:
+                # list of per-sequence lists (ragged or equal-length):
+                # concatenate to flat [sum(lengths), ...] form
+                data = np.concatenate([np.asarray(s) for s in data], axis=0)
+            self._flat = np.asarray(data)
         else:
             self._rec_lens = []
             if isinstance(data, (list, tuple)) and len(data) and \

@@ -308,6 +308,11 @@ class Block(object):
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None,
                   index=None):
+        attrs = dict(attrs or {})
+        # Stamp the current role (forward/backward/optimize) so the executor
+        # can tell model ops from grad/update machinery — parity with the
+        # reference's OpRole attr (framework/op_proto_maker.h).
+        attrs.setdefault('op_role', self.program._current_role)
         op = Operator(self, type, inputs, outputs, attrs)
         if index is None:
             self.ops.append(op)
@@ -340,6 +345,17 @@ class Program(object):
         self.random_seed = 0
         self._version = 0
         self._seed_counter = 0
+        self._current_role = 'forward'
+
+    @contextlib.contextmanager
+    def op_role_guard(self, role):
+        """Ops appended inside the guard are stamped with `role`
+        ('forward' | 'backward' | 'optimize')."""
+        old, self._current_role = self._current_role, role
+        try:
+            yield
+        finally:
+            self._current_role = old
 
     # executor cache invalidation -----------------------------------------
     def _bump_version(self):

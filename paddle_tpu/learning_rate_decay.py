@@ -75,11 +75,22 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate,
 def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
                      power=1.0, cycle=False):
     global_step = _decay_step_counter()
-    gs = layers.elementwise_min(
-        x=global_step,
-        y=layers.fill_constant(shape=[1], dtype='float32',
-                               value=float(decay_steps)))
-    frac = layers.scale(x=gs, scale=1.0 / float(decay_steps))
+    if cycle:
+        # decay_steps grows to decay_steps * ceil(step/decay_steps) so the
+        # schedule restarts each period (fluid polynomial_decay parity).
+        periods = layers.ceil(
+            x=layers.scale(x=global_step, scale=1.0 / float(decay_steps)))
+        periods = layers.elementwise_max(
+            x=periods,
+            y=layers.fill_constant(shape=[1], dtype='float32', value=1.0))
+        steps = layers.scale(x=periods, scale=float(decay_steps))
+        frac = layers.elementwise_div(x=global_step, y=steps)
+    else:
+        gs = layers.elementwise_min(
+            x=global_step,
+            y=layers.fill_constant(shape=[1], dtype='float32',
+                                   value=float(decay_steps)))
+        frac = layers.scale(x=gs, scale=1.0 / float(decay_steps))
     one_minus = layers.scale(x=frac, scale=-1.0, bias=1.0)
     powed = layers.pow(x=one_minus, attrs={'factor': float(power)})
     return layers.scale(x=powed,

@@ -21,7 +21,9 @@ def _lookup_table(ctx, ins, attrs):
         ids = ids.squeeze(-1)
     y = jnp.take(w, ids, axis=0)
     pad = attrs.get('padding_idx', None)
-    if pad is not None and pad >= 0:
+    if pad is not None:
+        if pad < 0:  # fluid convention: -1 means row vocab_size-1
+            pad = w.shape[0] + pad
         mask = (ids != pad)[..., None]
         y = jnp.where(mask, y, jnp.zeros_like(y))
     return out(y)

@@ -35,7 +35,7 @@ def _auc(ctx, ins, attrs):
         score = probs[:, 1]
     else:
         score = probs.reshape(-1)
-    num_t = 200
+    num_t = int(attrs.get('num_thresholds', 200))
     thresholds = (jnp.arange(num_t, dtype=jnp.float32) + 0.5) / num_t
     pos = (label == 1)
     above = score[None, :] >= thresholds[:, None]

@@ -115,22 +115,27 @@ class Optimizer(object):
         self._create_global_learning_rate(program)
 
         optimize_ops = []
-        for param_and_grad in parameters_and_grads:
-            if param_and_grad[1] is None:
-                continue
-            if getattr(param_and_grad[0], 'trainable', True):
-                optimize_ops.append(
-                    self._append_optimize_op(block, param_and_grad))
-        self._finish_update(block)
-        self._increment_global_step(block)
+        with program.op_role_guard('optimize'):
+            for param_and_grad in parameters_and_grads:
+                if param_and_grad[1] is None:
+                    continue
+                if getattr(param_and_grad[0], 'trainable', True):
+                    optimize_ops.append(
+                        self._append_optimize_op(block, param_and_grad))
+            self._finish_update(block)
+            self._increment_global_step(block)
         return optimize_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         params_grads = append_backward(loss, parameter_list, no_grad_set)
-        params_grads = append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(params_grads,
-                                                 self.regularization)
+        # clip/regularization ops transform grads: backward role, so they run
+        # at top level after the autodiff op (never re-traced by a later
+        # minimize() pass on the same program).
+        with loss.block.program.op_role_guard('backward'):
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
         optimize_ops = self.create_optimization_pass(
             params_grads, loss, startup_program)
         return optimize_ops, params_grads

@@ -1,0 +1,136 @@
+"""Autodiff machinery tests: multi-minimize programs (GAN pattern),
+calc_gradient wrt intermediates, error clip, op roles."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_two_minimize_passes_one_program():
+    """GAN-style: two losses, two optimizers over disjoint param sets, one
+    program (regression: second autodiff used to re-trace the first)."""
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    a = fluid.layers.fc(input=x, size=4, act='tanh',
+                        param_attr=fluid.ParamAttr(name='net_a_w'),
+                        bias_attr=fluid.ParamAttr(name='net_a_b'))
+    loss_a = fluid.layers.mean(x=fluid.layers.square(x=a))
+    b = fluid.layers.fc(input=x, size=4, act='tanh',
+                        param_attr=fluid.ParamAttr(name='net_b_w'),
+                        bias_attr=fluid.ParamAttr(name='net_b_b'))
+    loss_b = fluid.layers.mean(x=fluid.layers.square(x=b))
+
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(
+        loss_a, parameter_list=['net_a_w', 'net_a_b'])
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(
+        loss_b, parameter_list=['net_b_w', 'net_b_b'])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(8, 4).astype('float32')
+    la0, lb0 = None, None
+    for i in range(20):
+        la, lb = exe.run(feed={'x': xv}, fetch_list=[loss_a, loss_b])
+        if i == 0:
+            la0, lb0 = float(la.ravel()[0]), float(lb.ravel()[0])
+    assert float(la.ravel()[0]) < la0
+    assert float(lb.ravel()[0]) < lb0
+
+
+def test_calc_gradient_wrt_input():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    x.stop_gradient = False
+    y = fluid.layers.square(x=x)
+    loss = fluid.layers.reduce_sum(input=y)
+    (gx,) = fluid.backward.calc_gradient(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1., 2., 3.]], dtype='float32')
+    g, = exe.run(feed={'x': xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-5)
+
+
+def test_calc_gradient_wrt_intermediate():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    h = fluid.layers.scale(x=x, scale=3.0)
+    y = fluid.layers.square(x=h)
+    loss = fluid.layers.reduce_sum(input=y)
+    (gh,) = fluid.backward.calc_gradient(loss, h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1., 2., 3.]], dtype='float32')
+    g, = exe.run(feed={'x': xv}, fetch_list=[gh])
+    np.testing.assert_allclose(g, 2 * 3 * xv, rtol=1e-5)  # d/dh sum(h^2)=2h
+
+
+def test_error_clip_by_value():
+    x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+    x.stop_gradient = False
+    h = fluid.layers.scale(x=x, scale=100.0)
+    h.error_clip = fluid.clip.ErrorClipByValue(max=0.01)
+    loss = fluid.layers.reduce_sum(input=h)
+    (gx,) = fluid.backward.calc_gradient(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    g, = exe.run(feed={'x': np.ones((1, 3), 'float32')}, fetch_list=[gx])
+    # dloss/dh = 1 clipped to 0.01, then through scale: 0.01*100 = 1.0
+    np.testing.assert_allclose(g, np.full((1, 3), 1.0), rtol=1e-5)
+
+
+def test_gradient_clip_by_global_norm():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.fc(input=x, size=2)
+    loss = fluid.layers.mean(x=fluid.layers.square(x=y))
+    fluid.clip.set_gradient_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=1e-8))
+    try:
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    finally:
+        fluid.clip.set_gradient_clip(None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w_name = [v.name for v in fluid.default_main_program().list_vars()
+              if isinstance(v, fluid.Parameter)][0]
+    before = fluid.global_scope().get_numpy(w_name)
+    exe.run(feed={'x': np.random.rand(8, 4).astype('float32')},
+            fetch_list=[loss])
+    after = fluid.global_scope().get_numpy(w_name)
+    # grads clipped to ~1e-8 global norm → params essentially unchanged
+    assert np.max(np.abs(after - before)) < 1e-6
+
+
+def test_lod_tensor_ragged_with_seq_lens():
+    t = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]])
+    np.testing.assert_array_equal(t.lengths(), [2, 3])
+    np.testing.assert_array_equal(t.padded(), [[1, 2, 0], [3, 4, 5]])
+
+
+def test_multi_minimize_program_order_semantics():
+    """Fetched loss_a must be the program-order value (computed before any
+    optimizer update), and loss_b's grads must see pre-update upstream
+    activations — parity with the reference's run-once-in-order executor."""
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    h = fluid.layers.fc(input=x, size=4, act='tanh',
+                        param_attr=fluid.ParamAttr(name='w1'),
+                        bias_attr=False)
+    loss_a = fluid.layers.mean(x=fluid.layers.square(x=h))
+    g = fluid.layers.fc(input=h, size=4, act='tanh',
+                        param_attr=fluid.ParamAttr(name='w2'),
+                        bias_attr=False)
+    loss_b = fluid.layers.mean(x=fluid.layers.square(x=g))
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(
+        loss_a, parameter_list=['w1'])
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(
+        loss_b, parameter_list=['w2'])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(8, 4).astype('float32')
+    w1_before = fluid.global_scope().get_numpy('w1')
+    la, lb = exe.run(feed={'x': xv}, fetch_list=[loss_a, loss_b])
+    # program-order reference values with the pre-update w1
+    h_ref = np.tanh(xv @ w1_before)
+    np.testing.assert_allclose(float(la.ravel()[0]),
+                               np.mean(h_ref ** 2), rtol=1e-4)
+
+
+def test_lod_tensor_equal_length_seqs():
+    t = fluid.create_lod_tensor([[1, 2], [3, 4]], [[2, 2]])
+    np.testing.assert_array_equal(t.padded(), [[1, 2], [3, 4]])
+    t2 = fluid.create_lod_tensor(np.arange(4).reshape(4, 1), [[1, 3]])
+    np.testing.assert_array_equal(t2.padded(),
+                                  [[[0], [0], [0]], [[1], [2], [3]]])
