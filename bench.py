@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet training throughput, img/sec on
+one chip (SURVEY.md §5; reference number: 61 img/s/GPU fp32 batch 64 on
+Tesla P40, benchmark/cluster docs).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The whole train step (forward + backward + momentum update) is one jitted
+XLA program with donated parameter buffers — steady-state steps do zero
+host work beyond the feed.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_S = 61.0  # reference P40 fp32, batch 64
+
+
+def main():
+    import jax
+    on_tpu = any(d.platform == 'tpu' for d in jax.devices())
+    # CPU smoke mode (CI): tiny shapes, still the full train-step path
+    if on_tpu:
+        batch, hw, depth, classes, steps, warmup = 64, 224, 50, 1000, 20, 3
+    else:
+        batch, hw, depth, classes, steps, warmup = 8, 64, 18, 100, 3, 1
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img, label, prediction, avg_cost, acc = resnet.build_imagenet(
+            depth=depth, num_classes=classes, image_shape=(3, hw, hw))
+        opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                momentum=0.9)
+        opt.minimize(avg_cost)
+
+    place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(batch, 3, hw, hw)).astype(np.float32)
+    labels = rng.integers(0, classes, size=(batch, 1)).astype(np.int32)
+    feed = {'img': images, 'label': labels}
+
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    # fetch already synced (numpy conversion)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    result = {
+        "metric": "resnet%d_train_img_per_sec_per_chip" % depth,
+        "value": round(img_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+    }
+    if not on_tpu:
+        result["note"] = "cpu-smoke (depth=%d hw=%d batch=%d)" % (
+            depth, hw, batch)
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -41,6 +41,13 @@ from . import io
 from .data_feeder import DataFeeder
 from .param_attr import ParamAttr
 from . import profiler
+from . import reader
+from . import datasets
+from .reader.minibatch import batch
+dataset = datasets  # parity alias: paddle.v2.dataset
+from . import parallel
+from . import distributed
+from .distributed import DistributeTranspiler, SimpleDistributeTranspiler
 
 Tensor = LoDTensor
 
@@ -49,7 +56,9 @@ __version__ = '0.1.0'
 __all__ = [
     'core', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
     'regularizer', 'learning_rate_decay', 'clip', 'evaluator', 'io',
-    'profiler',
+    'profiler', 'reader', 'datasets', 'dataset', 'batch',
+    'parallel', 'distributed', 'DistributeTranspiler',
+    'SimpleDistributeTranspiler',
     'Executor', 'Program', 'Block', 'Operator', 'Variable', 'Parameter',
     'Scope', 'LoDTensor', 'Tensor', 'ParamAttr', 'DataFeeder',
     'CPUPlace', 'CUDAPlace', 'TPUPlace', 'XLAPlace',

@@ -180,7 +180,10 @@ def _run_ops(ops, env, ctx):
             if _op_role(op) == 'optimize':
                 for n in op.output_arg_names:
                     if n in env and n not in pre_update_vals:
-                        pre_update_vals[n] = env[n]
+                        # (pre-update value, program index of the update):
+                        # a later autodiff rolls `n` back only for forward
+                        # ops that originally ran before this index
+                        pre_update_vals[n] = (env[n], i)
             _run_one(op, env, ctx, i)
 
 
@@ -192,7 +195,16 @@ def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
     loss_scale = ad_op.attrs.get('loss_scale', 1.0)
 
     captured = dict(env)
-    captured.update(pre_update_vals)
+    # Keep the POST-update value only when every forward op in this slice
+    # that reads the var originally ran after its update (ops built after
+    # a minimize() see the updated value in the reference executor too).
+    # A slice whose reads straddle the update has no single consistent
+    # value; we choose the pre-update one so gradients attach to the
+    # values the pre-update forward saw (the common multi-loss pattern).
+    for n, (val, upd_idx) in pre_update_vals.items():
+        read_idxs = [j for j, op in fwd_ops if n in op.input_arg_names]
+        if not read_idxs or min(read_idxs) < upd_idx:
+            captured[n] = val
     written = set()
     for _, op in fwd_ops:
         written.update(op.output_arg_names)
@@ -213,6 +225,18 @@ def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
     def f(ps):
         env2 = dict(captured)
         env2.update(ps)
+        # fluid's error_clip also guards leaf vars (fed data / Parameters):
+        # they enter the VJP here as leaves, so the clip must ride their
+        # injected value, not a producing op's output (there is none).
+        for n in param_names:
+            try:
+                var = ctx.block.var_recursive(n)
+            except KeyError:
+                continue
+            ec = getattr(var, 'error_clip', None)
+            if ec is not None:
+                env2[n] = _clip_cotangent(env2[n], float(ec.min),
+                                          float(ec.max))
         for j, op in fwd_ops:
             _run_one(op, env2, ctx, j, frozen)
         loss = env2[loss_name]
@@ -309,7 +333,7 @@ class Executor(object):
 
         plan = self._get_plan(program, block, scope, feed_arrays,
                               tuple(fetch_names), use_program_cache)
-        (fn, state_rw_names, state_ro_names) = plan
+        (fn, _raw, state_rw_names, state_ro_names) = plan
 
         state_rw = {n: scope.get(n) for n in state_rw_names}
         state_ro = {n: scope.get(n) for n in state_ro_names}
@@ -401,10 +425,52 @@ class Executor(object):
             return fetches, new_state
 
         fn = jax.jit(step_fn, donate_argnums=(1,))
-        plan = (fn, state_rw_names, state_ro_names)
+        plan = (fn, step_fn, state_rw_names, state_ro_names)
         if use_cache:
             self._cache[key] = plan
         return plan
+
+    def _compile_common(self, program, feed, fetch_list, scope):
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f)
+            for f in (fetch_list or [])
+        ]
+        block = program.global_block()
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = block.vars.get(name)
+            feed_arrays.update(_to_feed_arrays(name, value, var))
+        fn, raw, rw_names, ro_names = self._get_plan(
+            program, block, scope, feed_arrays, tuple(fetch_names), True)
+        state_rw = {n: scope.get(n) for n in rw_names}
+        state_ro = {n: scope.get(n) for n in ro_names}
+        rng_key = self._rng_key(program)
+        return fn, raw, (feed_arrays, state_rw, state_ro, rng_key)
+
+    def compile(self, program=None, feed=None, fetch_list=None, scope=None):
+        """Build (but do not run) the jitted step function for a program.
+
+        Returns (fn, example_args) where ``fn(feed, state_rw, state_ro,
+        rng_key) -> (fetches, new_state)`` is the whole-block XLA
+        computation — the hook used by __graft_entry__ and jax.export.
+        """
+        fn, _raw, args = self._compile_common(program, feed, fetch_list,
+                                              scope)
+        return fn, args
+
+    def compile_raw(self, program=None, feed=None, fetch_list=None,
+                    scope=None):
+        """Like compile(), but returns the UN-jitted python step function —
+        the hook for re-jitting with explicit shardings (parallel/api.py)
+        or custom transforms."""
+        _fn, raw, args = self._compile_common(program, feed, fetch_list,
+                                              scope)
+        return raw, args
 
     def close(self):
         self._cache.clear()

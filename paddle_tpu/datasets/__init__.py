@@ -1,0 +1,14 @@
+"""Datasets with the python/paddle/v2/dataset API surface.
+
+Zero-egress environment: every module defaults to a deterministic,
+*learnable* synthetic generator with the real data's field structure,
+dtypes and vocab sizes (see each module's docstring and common.py).
+"""
+from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt14)
+
+__all__ = [
+    'mnist', 'imikolov', 'imdb', 'cifar', 'movielens', 'conll05',
+    'sentiment', 'uci_housing', 'wmt14', 'flowers', 'voc2012', 'mq2007',
+    'common',
+]

@@ -1,0 +1,63 @@
+"""CIFAR-10/100.  Reference parity: python/paddle/v2/dataset/cifar.py —
+train10/test10 yield (float32[3072] in [0,1], label in [0,10)); train100/
+test100 labels in [0,100).
+
+Synthetic task: per-class color/texture templates + noise (32x32x3, CHW
+flattened like the reference).
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train100', 'test100', 'train10', 'test10', 'convert']
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 1024
+
+
+def _templates(num_classes):
+    rng = common.rng_for('cifar%d' % num_classes, 'templates')
+    t = rng.random(size=(num_classes, 3, 32, 32)).astype(np.float32)
+    t = (t + np.roll(t, 1, axis=2) + np.roll(t, 1, axis=3)) / 3.0
+    return t.reshape(num_classes, 3072)
+
+
+def reader_creator(num_classes, split, size):
+    def reader():
+        if not common.synth_enabled():
+            raise RuntimeError("real CIFAR unavailable (zero egress)")
+        tpl = _templates(num_classes)
+        rng = common.rng_for('cifar%d' % num_classes, split)
+        for _ in range(common.data_size(size)):
+            label = int(rng.integers(0, num_classes))
+            img = tpl[label] + 0.25 * rng.normal(size=3072)
+            yield np.clip(img, 0, 1).astype(np.float32), label
+
+    return reader
+
+
+def train100():
+    return reader_creator(100, 'train', TRAIN_SIZE)
+
+
+def test100():
+    return reader_creator(100, 'test', TEST_SIZE)
+
+
+def train10():
+    return reader_creator(10, 'train', TRAIN_SIZE)
+
+
+def test10():
+    return reader_creator(10, 'test', TEST_SIZE)
+
+
+def fetch():
+    pass
+
+
+def convert(path):
+    common.convert(path, train100(), 1000, "cifar_train100")
+    common.convert(path, test100(), 1000, "cifar_test100")
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

@@ -1,0 +1,148 @@
+"""Dataset commons.
+
+Reference parity: python/paddle/v2/dataset/common.py (download cache,
+convert-to-recordio, file splitting).  This environment is zero-egress, so
+every dataset module ships a *synthetic generator* producing samples with
+the exact field structure, dtypes, value ranges and vocab sizes of the real
+data (documented per-module).  The synthetic tasks are constructed to be
+*learnable* (labels are functions of the features) so the book convergence
+tests exercise real training dynamics.
+
+Set PADDLE_TPU_SYNTH_DATA=0 to require real files under DATA_HOME (they
+must have been placed there out-of-band; download() raises otherwise).
+"""
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ['DATA_HOME', 'synth_enabled', 'data_size', 'rng_for', 'download',
+           'md5file', 'split', 'cluster_files_reader', 'convert',
+           'zipf_seq', 'seq_lengths']
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get('PADDLE_TPU_DATA_HOME', '~/.cache/paddle_tpu/dataset'))
+
+
+def synth_enabled():
+    return os.environ.get('PADDLE_TPU_SYNTH_DATA', '1') != '0'
+
+
+def data_size(default):
+    """Scale synthetic dataset sizes via PADDLE_TPU_DATA_SCALE (float)."""
+    scale = float(os.environ.get('PADDLE_TPU_DATA_SCALE', '1'))
+    return max(8, int(default * scale))
+
+
+def rng_for(name, split='train'):
+    """Deterministic per-(dataset, split) numpy Generator."""
+    h = hashlib.md5(('paddle_tpu:%s:%s' % (name, split)).encode()).digest()
+    return np.random.default_rng(int.from_bytes(h[:8], 'little'))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Return the cached path for a dataset file.  Zero-egress: if the file
+    is not already present under DATA_HOME, raises (use synthetic mode)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname,
+                            save_name or url.split('/')[-1])
+    if not os.path.exists(filename):
+        raise RuntimeError(
+            "dataset file %s is absent and this environment has no network "
+            "egress; place the file there manually or use the synthetic "
+            "data mode (PADDLE_TPU_SYNTH_DATA=1, default)" % filename)
+    if md5sum and md5file(filename) != md5sum:
+        raise RuntimeError("md5 mismatch for %s" % filename)
+    return filename
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into multiple pickled chunk files
+    (reference: common.split)."""
+    import pickle
+    dumper = dumper or pickle.dump
+    indx_f = 0
+    lines = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over a shard of chunk files for this trainer (reference:
+    common.cluster_files_reader)."""
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_file_list = [f for i, f in enumerate(file_list)
+                        if i % trainer_count == trainer_id]
+        for fn in my_file_list:
+            with open(fn, "rb") as f:
+                lines = loader(f)
+                for line in lines:
+                    yield line
+
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Dump a reader into length-prefixed record files (the TPU-native
+    recordio, paddle_tpu/io_recordio.py) for fast re-reads."""
+    from ..io_recordio import RecordWriter
+    import pickle
+    indx_f = 0
+    lines = []
+
+    def flush():
+        nonlocal indx_f, lines
+        if not lines:
+            return
+        path = os.path.join(output_path,
+                            "%s-%05d" % (name_prefix, indx_f))
+        with RecordWriter(path) as w:
+            for d in lines:
+                w.write(pickle.dumps(d))
+        lines = []
+        indx_f += 1
+
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if len(lines) >= line_count:
+            flush()
+    flush()
+
+
+# ---------------------------------------------------------------------------
+# synthetic-text helpers
+
+def zipf_seq(rng, length, vocab_size, low=0):
+    """Zipf-distributed token ids in [low, vocab_size) — matches natural
+    token frequency so embedding/softmax training behaves realistically."""
+    ranks = rng.zipf(1.3, size=length)
+    return (low + (ranks - 1) % (vocab_size - low)).astype(np.int64)
+
+
+def seq_lengths(rng, n, lo, hi):
+    """Sequence lengths roughly geometric in [lo, hi]."""
+    raw = rng.geometric(2.0 / (lo + hi), size=n)
+    return np.clip(raw, lo, hi).astype(np.int64)

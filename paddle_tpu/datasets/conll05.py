@@ -1,0 +1,101 @@
+"""CoNLL-2005 SRL.  Reference parity: python/paddle/v2/dataset/conll05.py
+— test() yields 9 slots: word_idx seq, 5 predicate-context id seqs
+(broadcast to sentence length), pred_idx seq, mark (0/1) seq, label_idx
+seq (BIO tags).  get_dict() → (word_dict, verb_dict, label_dict).
+
+Synthetic task: BIO argument spans are placed deterministically around a
+random predicate position, with span label derived from (predicate id,
+distance) — a structured-prediction task a BiLSTM-CRF can learn.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['test', 'get_dict', 'get_embedding', 'convert']
+
+WORD_VOCAB = 4427
+PRED_VOCAB = 300
+# label dict: 'O' + B-/I- for rel + A0..A4 etc — reference has 67 labels
+_ARGS = ['A0', 'A1', 'A2', 'A3', 'A4', 'AM-TMP', 'AM-LOC', 'AM-MNR', 'V']
+UNK_IDX = 0
+TEST_SIZE = 1024
+
+
+def word_dict_size():
+    return WORD_VOCAB
+
+
+def _label_list():
+    labels = ['O']
+    for a in _ARGS:
+        labels.append('B-' + a)
+        labels.append('I-' + a)
+    return labels
+
+
+def get_dict():
+    word_dict = {('w%04d' % i): i for i in range(WORD_VOCAB)}
+    verb_dict = {('v%03d' % i): i for i in range(PRED_VOCAB)}
+    label_dict = {l: i for i, l in enumerate(_label_list())}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Synthetic pretrained 32-d embedding table for the word dict."""
+    rng = common.rng_for('conll05', 'emb')
+    return rng.normal(scale=0.1, size=(WORD_VOCAB, 32)).astype(np.float32)
+
+
+def reader_creator(split='test', size=TEST_SIZE):
+    word_dict, verb_dict, label_dict = get_dict()
+    labels = _label_list()
+
+    def reader():
+        rng = common.rng_for('conll05', split)
+        lens = common.seq_lengths(rng, common.data_size(size), 5, 30)
+        for L in lens:
+            L = int(L)
+            words = common.zipf_seq(rng, L, WORD_VOCAB)
+            verb_index = int(rng.integers(0, L))
+            pred = int(words[verb_index] % PRED_VOCAB)
+            # deterministic argument span: A0 before the verb, A1 after
+            tags = ['O'] * L
+            tags[verb_index] = 'B-V'
+            a0_len = min(verb_index, 1 + pred % 3)
+            for k in range(a0_len):
+                tags[verb_index - 1 - k] = 'I-A0' if k < a0_len - 1 else \
+                    'B-A0'
+            a1_len = min(L - verb_index - 1, 1 + (pred // 3) % 3)
+            for k in range(a1_len):
+                tags[verb_index + 1 + k] = 'B-A1' if k == 0 else 'I-A1'
+            mark = [0] * L
+            for d in (-2, -1, 0, 1, 2):
+                if 0 <= verb_index + d < L:
+                    mark[verb_index + d] = 1
+
+            def ctx(d):
+                i = verb_index + d
+                if i < 0 or i >= L:
+                    return UNK_IDX
+                return int(words[i])
+
+            word_idx = [int(w) for w in words]
+            label_idx = [label_dict.get(t, label_dict['O']) for t in tags]
+            yield (word_idx,
+                   [ctx(-2)] * L, [ctx(-1)] * L, [ctx(0)] * L,
+                   [ctx(1)] * L, [ctx(2)] * L,
+                   [pred] * L, mark, label_idx)
+
+    return reader
+
+
+def test():
+    return reader_creator('test')
+
+
+def fetch():
+    pass
+
+
+def convert(path):
+    common.convert(path, test(), 1000, "conl105_test")

@@ -1,0 +1,60 @@
+"""Oxford-102 flowers.  Reference parity:
+python/paddle/v2/dataset/flowers.py — train()/test()/valid() yield
+(float32 CHW image flattened, label in [0,102)); reference feeds 3x224x224
+crops through its image pipeline.
+
+Synthetic: class-colored blobs at 3x224x224 (downscalable via
+``use_xmap``-independent ``mapper``).
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'valid']
+
+NUM_CLASSES = 102
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+H = W = 224
+
+
+def _class_color(label):
+    rng = common.rng_for('flowers', 'palette')
+    palette = rng.random(size=(NUM_CLASSES, 3)).astype(np.float32)
+    return palette[label]
+
+
+def reader_creator(split, size, mapper=None, buffered_size=1024,
+                   use_xmap=True):
+    def reader():
+        rng = common.rng_for('flowers', split)
+        for _ in range(common.data_size(size)):
+            label = int(rng.integers(0, NUM_CLASSES))
+            color = _class_color(label)
+            img = np.empty((3, H, W), dtype=np.float32)
+            img[:] = color[:, None, None]
+            img += 0.2 * rng.normal(size=(3, H, W)).astype(np.float32)
+            sample = (np.clip(img, 0, 1).reshape(-1), label)
+            if mapper is not None:
+                sample = mapper(sample)
+            yield sample
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return reader_creator('train', TRAIN_SIZE, mapper, buffered_size,
+                          use_xmap)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return reader_creator('test', TEST_SIZE, mapper, buffered_size, use_xmap)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return reader_creator('valid', TEST_SIZE, mapper, buffered_size,
+                          use_xmap)
+
+
+def fetch():
+    pass

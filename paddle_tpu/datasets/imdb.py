@@ -1,0 +1,78 @@
+"""IMDB sentiment.  Reference parity: python/paddle/v2/dataset/imdb.py —
+train(word_idx)/test(word_idx) yield ([word ids], label in {0,1});
+word_dict() returns token -> id with '<unk>' as the last id.
+
+Synthetic task: Zipf token streams where a hidden set of "positive" and
+"negative" token ids is planted; the label is which set dominates — a
+bag-of-words-learnable sentiment task.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['build_dict', 'train', 'test', 'word_dict', 'convert']
+
+VOCAB_SIZE = 5148  # close to the real cutoff-150 imdb dict
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+_POS_TOKENS = None
+_NEG_TOKENS = None
+
+
+def _polar_tokens():
+    global _POS_TOKENS, _NEG_TOKENS
+    if _POS_TOKENS is None:
+        rng = common.rng_for('imdb', 'polarity')
+        ids = rng.permutation(VOCAB_SIZE - 1)[:200]
+        _POS_TOKENS, _NEG_TOKENS = set(ids[:100]), set(ids[100:])
+    return _POS_TOKENS, _NEG_TOKENS
+
+
+def word_dict():
+    """token -> id; '<unk>' is the final id (reference imdb.word_dict)."""
+    d = {('w%04d' % i): i for i in range(VOCAB_SIZE - 1)}
+    d['<unk>'] = VOCAB_SIZE - 1
+    return d
+
+
+def build_dict(pattern=None, cutoff=150):
+    return word_dict()
+
+
+def reader_creator(split, size, word_idx):
+    n_words = max(word_idx.values()) + 1 if word_idx else VOCAB_SIZE
+
+    def reader():
+        pos, neg = _polar_tokens()
+        rng = common.rng_for('imdb', split)
+        lens = common.seq_lengths(rng, common.data_size(size), 8, 120)
+        for L in lens:
+            ids = common.zipf_seq(rng, int(L), n_words)
+            label = int(rng.integers(0, 2))
+            # plant polarity tokens proportional to the label
+            planted = (pos if label == 0 else neg)  # reference: 0=pos file
+            k = max(1, int(L) // 6)
+            where = rng.integers(0, int(L), size=k)
+            planted = np.fromiter(planted, dtype=np.int64)
+            ids[where] = planted[rng.integers(0, len(planted), size=k)]
+            yield ids.tolist(), label
+
+    return reader
+
+
+def train(word_idx):
+    return reader_creator('train', TRAIN_SIZE, word_idx)
+
+
+def test(word_idx):
+    return reader_creator('test', TEST_SIZE, word_idx)
+
+
+def fetch():
+    pass
+
+
+def convert(path):
+    w = word_dict()
+    common.convert(path, lambda: train(w)(), 1000, "imdb_train")
+    common.convert(path, lambda: test(w)(), 1000, "imdb_test")

@@ -1,0 +1,85 @@
+"""imikolov (PTB-ish LM data).  Reference parity:
+python/paddle/v2/dataset/imikolov.py — build_dict(min_word_freq) returns
+word -> id ('<s>', '<e>', '<unk>' included); train(word_idx, n) yields
+n-gram tuples of ids; with DataType.SEQ yields whole sentences
+[<s> w1 ... wk <e>] as ([src ids], [next ids]).
+
+Synthetic task: order-2 Markov chains over a Zipf vocabulary so n-gram
+models have actual signal to fit.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'build_dict', 'convert', 'DataType']
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+VOCAB_SIZE = 2074  # close to real min_word_freq=50 dict size
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def build_dict(min_word_freq=50):
+    d = {('w%04d' % i): i for i in range(VOCAB_SIZE - 3)}
+    d['<s>'] = VOCAB_SIZE - 3
+    d['<e>'] = VOCAB_SIZE - 2
+    d['<unk>'] = VOCAB_SIZE - 1
+    return d
+
+
+def _markov_step(rng, prev, vocab):
+    # deterministic "grammar": each token's successors are a small fixed set
+    base = (prev * 1103515245 + 12345) % vocab
+    k = int(rng.integers(0, 4))
+    if k == 3:  # escape to an unconditioned Zipf draw 25% of the time
+        return int(common.zipf_seq(rng, 1, vocab)[0])
+    return int((base + k) % vocab)
+
+
+def reader_creator(split, size, word_idx, n, data_type):
+    vocab = max(word_idx.values()) + 1 if word_idx else VOCAB_SIZE
+
+    def reader():
+        rng = common.rng_for('imikolov', split)
+        lens = common.seq_lengths(rng, common.data_size(size), 4, 30)
+        for L in lens:
+            sent = [int(common.zipf_seq(rng, 1, vocab)[0])]
+            for _ in range(int(L) - 1):
+                sent.append(_markov_step(rng, sent[-1], vocab))
+            if data_type == DataType.NGRAM:
+                if len(sent) >= n:
+                    sent_arr = np.asarray(sent)
+                    for i in range(n, len(sent_arr) + 1):
+                        yield tuple(int(x) for x in sent_arr[i - n:i])
+            elif data_type == DataType.SEQ:
+                src = [word_idx.get('<s>', vocab - 3)] + sent
+                trg = sent + [word_idx.get('<e>', vocab - 2)]
+                yield src, trg
+            else:
+                raise ValueError("unsupported data_type")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator('train', TRAIN_SIZE, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator('test', TEST_SIZE, word_idx, n, data_type)
+
+
+def fetch():
+    pass
+
+
+def convert(path):
+    N = 5
+    word_d = build_dict()
+    common.convert(path, train(word_d, N), 1000, "imikolov_train")
+    common.convert(path, test(word_d, N), 1000, "imikolov_test")

@@ -1,0 +1,59 @@
+"""MNIST.  Reference parity: python/paddle/v2/dataset/mnist.py — train()/
+test() yield (image float32[784] scaled to [-1, 1], label int in [0, 10)).
+
+Synthetic task: ten fixed random digit "templates" (one per class) plus
+gaussian noise — linearly separable enough for the book tests' convnet/MLP
+to reach their accuracy thresholds, hard enough that training has to work.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'convert']
+
+TRAIN_SIZE = 8192
+TEST_SIZE = 2048
+
+
+def _templates():
+    rng = common.rng_for('mnist', 'templates')
+    t = rng.normal(size=(10, 784)).astype(np.float32)
+    # smooth the templates a little so conv filters have local structure
+    img = t.reshape(10, 28, 28)
+    img = (img + np.roll(img, 1, axis=1) + np.roll(img, 1, axis=2)) / 3.0
+    return np.clip(img.reshape(10, 784), -1, 1)
+
+
+def reader_creator(split, size):
+    def reader():
+        if not common.synth_enabled():
+            raise RuntimeError(
+                "real MNIST files unavailable (zero egress); use "
+                "PADDLE_TPU_SYNTH_DATA=1")
+        tpl = _templates()
+        rng = common.rng_for('mnist', split)
+        n = common.data_size(size)
+        for i in range(n):
+            label = int(rng.integers(0, 10))
+            img = tpl[label] + 0.6 * rng.normal(size=784).astype(np.float32)
+            yield np.clip(img, -1, 1).astype(np.float32), label
+
+    return reader
+
+
+def train():
+    """MNIST training reader: (float32[784] in [-1,1], int label)."""
+    return reader_creator('train', TRAIN_SIZE)
+
+
+def test():
+    return reader_creator('test', TEST_SIZE)
+
+
+def fetch():
+    pass
+
+
+def convert(path):
+    common.convert(path, train(), 1000, "minist_train")
+    common.convert(path, test(), 1000, "minist_test")

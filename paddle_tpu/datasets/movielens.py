@@ -1,0 +1,139 @@
+"""MovieLens-1M.  Reference parity: python/paddle/v2/dataset/movielens.py
+— train()/test() readers yield 8 slots:
+[user_id, gender(0/1), age_idx(0..6), job_id, movie_id, [category ids],
+ [title word ids], [rating]] with rating rescaled to ``r*2-5``.
+
+Synthetic task: latent-factor model — each user and movie gets a hidden
+embedding; rating = <u, m> + bias + noise, so the recommender's
+cos_sim/factor model has real structure to learn.
+"""
+import functools
+
+import numpy as np
+
+from . import common
+
+__all__ = [
+    'train', 'test', 'get_movie_title_dict', 'max_movie_id', 'max_user_id',
+    'max_job_id', 'movie_categories', 'max_rating', 'age_table',
+    'movie_info', 'user_info', 'MovieInfo', 'UserInfo'
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+NUM_USERS = 600
+NUM_MOVIES = 400
+NUM_JOBS = 21
+NUM_CATEGORIES = 18
+TITLE_VOCAB = 1024
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+_LATENT = 8
+
+
+class MovieInfo(object):
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index, list(self.categories), list(self.title)]
+
+
+class UserInfo(object):
+    def __init__(self, index, gender, age_idx, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_idx
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+def _meta():
+    rng = common.rng_for('movielens', 'meta')
+    users = {}
+    for uid in range(1, NUM_USERS + 1):
+        users[uid] = UserInfo(uid, 'M' if rng.random() < 0.5 else 'F',
+                              int(rng.integers(0, len(age_table))),
+                              int(rng.integers(0, NUM_JOBS)))
+    movies = {}
+    for mid in range(1, NUM_MOVIES + 1):
+        ncat = int(rng.integers(1, 4))
+        cats = rng.permutation(NUM_CATEGORIES)[:ncat].tolist()
+        tlen = int(rng.integers(1, 6))
+        title = common.zipf_seq(rng, tlen, TITLE_VOCAB).tolist()
+        movies[mid] = MovieInfo(mid, cats, title)
+    u_emb = rng.normal(size=(NUM_USERS + 1, _LATENT)).astype(np.float32)
+    m_emb = rng.normal(size=(NUM_MOVIES + 1, _LATENT)).astype(np.float32)
+    return users, movies, u_emb, m_emb
+
+
+_META = None
+
+
+def _get_meta():
+    global _META
+    if _META is None:
+        _META = _meta()
+    return _META
+
+
+def __reader__(rand_seed=0, test_ratio=0.1, is_test=False):
+    users, movies, u_emb, m_emb = _get_meta()
+    split = 'test' if is_test else 'train'
+    rng = common.rng_for('movielens', split)
+    n = common.data_size(TEST_SIZE if is_test else TRAIN_SIZE)
+    for _ in range(n):
+        uid = int(rng.integers(1, NUM_USERS + 1))
+        mid = int(rng.integers(1, NUM_MOVIES + 1))
+        score = float(u_emb[uid] @ m_emb[mid]) / np.sqrt(_LATENT)
+        rating = np.clip(3.0 + score + 0.3 * rng.normal(), 1, 5)
+        rating = float(np.round(rating)) * 2 - 5.0
+        yield users[uid].value() + movies[mid].value() + [[rating]]
+
+
+def __reader_creator__(**kwargs):
+    return lambda: __reader__(**kwargs)
+
+
+train = functools.partial(__reader_creator__, is_test=False)
+test = functools.partial(__reader_creator__, is_test=True)
+
+
+def get_movie_title_dict():
+    return {('t%04d' % i): i for i in range(TITLE_VOCAB)}
+
+
+def max_movie_id():
+    return NUM_MOVIES
+
+
+def max_user_id():
+    return NUM_USERS
+
+
+def max_job_id():
+    return NUM_JOBS - 1
+
+
+def movie_categories():
+    return {('c%02d' % i): i for i in range(NUM_CATEGORIES)}
+
+
+def max_rating():
+    return 5.0
+
+
+def movie_info():
+    return _get_meta()[1]
+
+
+def user_info():
+    return _get_meta()[0]
+
+
+def fetch():
+    pass

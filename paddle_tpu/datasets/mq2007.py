@@ -1,0 +1,63 @@
+"""MQ2007 learning-to-rank.  Reference parity:
+python/paddle/v2/dataset/mq2007.py — readers in three formats:
+``pointwise`` (feature[46], relevance), ``pairwise`` ((f_hi, f_lo) with
+rel_hi > rel_lo), ``listwise`` (per-query label list + feature list).
+
+Synthetic: relevance = quantized linear score of the 46-d feature vector.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+FEATURE_DIM = 46
+QUERIES = 128
+DOCS_PER_QUERY = 8
+
+
+def _coef():
+    rng = common.rng_for('mq2007', 'coef')
+    return rng.normal(size=FEATURE_DIM).astype(np.float32)
+
+
+def _gen_query(rng, w):
+    feats = rng.normal(size=(DOCS_PER_QUERY, FEATURE_DIM)).astype(np.float32)
+    scores = feats @ w
+    rel = np.digitize(scores, np.quantile(scores, [0.5, 0.8]))  # 0,1,2
+    return rel.astype(np.int64), feats
+
+
+def reader_creator(split, format):
+    def reader():
+        w = _coef()
+        rng = common.rng_for('mq2007', split)
+        nq = common.data_size(QUERIES)
+        for _ in range(nq):
+            rel, feats = _gen_query(rng, w)
+            if format == 'pointwise':
+                for r, f in zip(rel, feats):
+                    yield float(r), f
+            elif format == 'pairwise':
+                for i in range(len(rel)):
+                    for j in range(len(rel)):
+                        if rel[i] > rel[j]:
+                            yield feats[i], feats[j]
+            elif format == 'listwise':
+                yield rel.astype(np.float32).tolist(), list(feats)
+            else:
+                raise ValueError("format must be pointwise/pairwise/listwise")
+
+    return reader
+
+
+def train(format='pairwise'):
+    return reader_creator('train', format)
+
+
+def test(format='pairwise'):
+    return reader_creator('test', format)
+
+
+def fetch():
+    pass

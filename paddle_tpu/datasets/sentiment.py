@@ -1,0 +1,30 @@
+"""NLTK movie-review sentiment.  Reference parity:
+python/paddle/v2/dataset/sentiment.py — train()/test() yield
+([word ids], label in {0,1}); get_word_dict() returns the frequency-sorted
+vocab.  Synthetic generation shares imdb's planted-polarity construction.
+"""
+from . import common, imdb
+
+__all__ = ['train', 'test', 'get_word_dict']
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb.reader_creator('sentiment-train', NUM_TRAINING_INSTANCES,
+                               get_word_dict())
+
+
+def test():
+    return imdb.reader_creator(
+        'sentiment-test', NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES,
+        get_word_dict())
+
+
+def fetch():
+    pass

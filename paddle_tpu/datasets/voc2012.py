@@ -1,0 +1,48 @@
+"""Pascal VOC2012 segmentation.  Reference parity:
+python/paddle/v2/dataset/voc2012.py — train()/test()/val() yield
+(image float32 CHW, label int32 HW mask with classes 0..20 and 255=void).
+
+Synthetic: colored rectangles on background; mask marks the rectangle.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'val']
+
+NUM_CLASSES = 21
+TRAIN_SIZE = 256
+TEST_SIZE = 64
+H = W = 128
+
+
+def reader_creator(split, size):
+    def reader():
+        rng = common.rng_for('voc2012', split)
+        for _ in range(common.data_size(size)):
+            img = rng.random(size=(3, H, W)).astype(np.float32) * 0.3
+            mask = np.zeros((H, W), dtype=np.int32)
+            cls = int(rng.integers(1, NUM_CLASSES))
+            y0, x0 = rng.integers(0, H // 2), rng.integers(0, W // 2)
+            h, w = rng.integers(H // 4, H // 2), rng.integers(W // 4, W // 2)
+            img[:, y0:y0 + h, x0:x0 + w] += (cls / NUM_CLASSES) * 0.7
+            mask[y0:y0 + h, x0:x0 + w] = cls
+            yield np.clip(img, 0, 1), mask
+
+    return reader
+
+
+def train():
+    return reader_creator('train', TRAIN_SIZE)
+
+
+def test():
+    return reader_creator('test', TEST_SIZE)
+
+
+def val():
+    return reader_creator('val', TEST_SIZE)
+
+
+def fetch():
+    pass

@@ -1,0 +1,84 @@
+"""WMT14 fr→en.  Reference parity: python/paddle/v2/dataset/wmt14.py —
+train(dict_size)/test(dict_size) yield (src_ids, trg_ids, trg_ids_next)
+where trg starts with <s> and trg_next ends with <e>; ids 0,1,2 are
+<s>, <e>, <unk>.  get_dict(dict_size) returns (src_dict, trg_dict).
+
+Synthetic task: the "translation" of a source sentence is a deterministic
+token-wise mapping plus local reordering — seq2seq with attention can
+genuinely learn it.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'build_dict', 'get_dict', 'convert']
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def _translate(src, dict_size):
+    # deterministic bijective-ish token map into the target vocab
+    out = [3 + ((3571 * t + 17) % (dict_size - 3)) for t in src]
+    # local reorder: swap adjacent pairs (French-ish adjective order)
+    for i in range(0, len(out) - 1, 2):
+        out[i], out[i + 1] = out[i + 1], out[i]
+    return out
+
+
+def reader_creator(split, size, dict_size):
+    def reader():
+        rng = common.rng_for('wmt14', split)
+        lens = common.seq_lengths(rng, common.data_size(size), 3, 25)
+        for L in lens:
+            src = (3 + common.zipf_seq(rng, int(L), dict_size - 3)).tolist()
+            trg = _translate(src, dict_size)
+            src_ids = src
+            trg_ids = [START_ID] + trg
+            trg_ids_next = trg + [END_ID]
+            yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(dict_size):
+    return reader_creator('train', TRAIN_SIZE, dict_size)
+
+
+def test(dict_size):
+    return reader_creator('test', TEST_SIZE, dict_size)
+
+
+def gen(dict_size):
+    return reader_creator('gen', TEST_SIZE // 4, dict_size)
+
+
+def build_dict(dict_size):
+    d = {START: START_ID, END: END_ID, UNK: UNK_ID}
+    for i in range(3, dict_size):
+        d['w%05d' % i] = i
+    return d
+
+
+def get_dict(dict_size, reverse=True):
+    src_dict = build_dict(dict_size)
+    trg_dict = build_dict(dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
+
+
+def fetch():
+    pass
+
+
+def convert(path):
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

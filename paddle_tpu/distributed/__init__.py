@@ -1,0 +1,5 @@
+from . import launch, transpiler
+from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
+
+__all__ = ['transpiler', 'launch', 'DistributeTranspiler',
+           'SimpleDistributeTranspiler']

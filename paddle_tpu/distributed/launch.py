@@ -1,0 +1,74 @@
+"""D7 — multi-host bring-up.
+
+Reference parity: benchmark/cluster + paddle.job launch env protocol
+(PADDLE_INIT_TRAINER_ID / PSERVERS / TRAINER_COUNT ...).  TPU-native:
+each host runs the SAME SPMD program; jax.distributed wires the hosts
+into one global device mesh over DCN, collectives inside a host ride ICI.
+
+Environment protocol (also accepts the reference's variable names):
+  PADDLE_TPU_COORDINATOR  host:port of process 0   (PSERVERS fallback)
+  PADDLE_TPU_NUM_PROCS    world size               (TRAINERS fallback)
+  PADDLE_TPU_PROC_ID      this process's rank      (TRAINER_ID fallback)
+"""
+import os
+
+__all__ = ['initialize', 'is_initialized', 'global_mesh', 'shutdown']
+
+_initialized = False
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return default
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None):
+    """Connect this host into the multi-host run.  No-op when single
+    -process (the common single-host case)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or _env(
+        'PADDLE_TPU_COORDINATOR', 'PADDLE_INIT_PSERVERS')
+    num_processes = num_processes or _env(
+        'PADDLE_TPU_NUM_PROCS', 'PADDLE_INIT_NUM_GRADIENT_SERVERS',
+        'PADDLE_INIT_TRAINER_COUNT')
+    process_id = process_id if process_id is not None else _env(
+        'PADDLE_TPU_PROC_ID', 'PADDLE_INIT_TRAINER_ID')
+    if not coordinator_address or num_processes in (None, '1'):
+        _initialized = True
+        return  # single host
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes),
+        process_id=int(process_id or 0))
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def global_mesh(shape, axis_names):
+    """Mesh over ALL hosts' devices (call after initialize()).  Axis order
+    should put intra-host axes (tp/sp) innermost so they ride ICI and the
+    cross-host axis (dp) outermost over DCN."""
+    from ..parallel import api
+    import jax
+    return api.make_mesh(shape, axis_names, devices=jax.devices())
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        import jax
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _initialized = False

@@ -1,0 +1,88 @@
+"""RecordIO-style record file: length-prefixed, CRC32-checked records.
+
+Reference parity: the reference caches converted datasets in recordio
+chunks (python/paddle/v2/dataset/common.py convert + recordio dep).  Format
+here: magic "PTRC", then per record: uint32 length, uint32 crc32, payload.
+A native C++ reader/writer with the same format lives in native/recordio.cc
+(used automatically when built — see runtime/native.py); this module is the
+portable implementation and the file-format authority.
+"""
+import os
+import struct
+import zlib
+
+__all__ = ['RecordWriter', 'RecordReader', 'read_records', 'write_records']
+
+_MAGIC = b'PTRC'
+_HDR = struct.Struct('<II')
+
+
+class RecordWriter(object):
+    def __init__(self, path):
+        self.path = path
+        self._f = open(path, 'wb')
+        self._f.write(_MAGIC)
+
+    def write(self, payload):
+        if not isinstance(payload, (bytes, bytearray)):
+            raise TypeError("record payload must be bytes")
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+
+    def close(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordReader(object):
+    def __init__(self, path):
+        self.path = path
+        self._f = open(path, 'rb')
+        if self._f.read(4) != _MAGIC:
+            raise ValueError("%s is not a paddle_tpu record file" % path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        hdr = self._f.read(_HDR.size)
+        if not hdr:
+            self._f.close()
+            raise StopIteration
+        if len(hdr) < _HDR.size:
+            raise IOError("truncated record header in %s" % self.path)
+        length, crc = _HDR.unpack(hdr)
+        payload = self._f.read(length)
+        if len(payload) < length:
+            raise IOError("truncated record payload in %s" % self.path)
+        if zlib.crc32(payload) != crc:
+            raise IOError("crc mismatch in %s" % self.path)
+        return payload
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path, payloads):
+    with RecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+
+
+def read_records(path):
+    with RecordReader(path) as r:
+        for p in r:
+            yield p

@@ -35,6 +35,7 @@ def fc(input,
     dtype = helper.input_dtype()
     lod = max(v.lod_level for v in helper.multiple_input())
     mul_results = []
+    flatten = num_flatten_dims
     for input_var, param_attr in helper.iter_inputs_and_params():
         input_shape = input_var.shape
         # Ragged inputs are padded [B, T, D] here (the reference sees the
@@ -63,8 +64,7 @@ def fc(input,
                          outputs={'Out': [pre_bias]})
         if lod > 0:
             _copy_len(helper, mul_results[0], pre_bias)
-    pre_activation = helper.append_bias_op(
-        pre_bias, dim_start=len(pre_bias.shape) - 1 if lod > 0 else 1)
+    pre_activation = helper.append_bias_op(pre_bias, dim_start=flatten)
     return helper.append_activation(pre_activation)
 
 

@@ -12,7 +12,7 @@ __all__ = [
     'sequence_first_step', 'sequence_last_step', 'sequence_expand',
     'sequence_concat', 'sequence_slice', 'sequence_erase', 'lod_reset',
     'dynamic_lstm', 'dynamic_gru', 'gru_unit', 'lstm_unit', 'chunk_eval',
-    'edit_distance', 'sequence_lengths',
+    'edit_distance', 'sequence_lengths', 'linear_chain_crf', 'crf_decoding',
 ]
 
 
@@ -77,14 +77,21 @@ def sequence_last_step(input, **kwargs):
     return sequence_pool(input, 'last')
 
 
-def sequence_softmax(x=None, input=None, **kwargs):
+def sequence_softmax(x=None, input=None, length_input=None, axis=1,
+                     **kwargs):
+    """Masked softmax over valid steps.  ``length_input`` (default: x)
+    names whose @LEN vector defines validity; ``axis`` is the time axis of
+    ``x`` being normalised — axis=2 with a [B, Td, Ts] score tensor is the
+    attention-over-encoder-states pattern (one masked softmax, no per-step
+    loop)."""
     x = x if x is not None else input
     helper = LayerHelper('sequence_softmax', **kwargs)
     out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
     inputs = {'X': [x]}
-    inputs.update(_len_input(helper, x))
+    inputs.update(_len_input(helper, length_input
+                             if length_input is not None else x))
     helper.append_op(type='sequence_softmax', inputs=inputs,
-                     outputs={'Out': [out]})
+                     outputs={'Out': [out]}, attrs={'axis': axis})
     _copy_len(helper, x, out)
     return out
 
@@ -276,6 +283,52 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
         outputs={'C': [c], 'H': [h]},
         attrs={'forget_bias': float(forget_bias)})
     return h, c
+
+
+def linear_chain_crf(input, label, param_attr=None, **kwargs):
+    """CRF negative log-likelihood cost per sequence: [B, 1].
+
+    Parity with fluid.layers.linear_chain_crf (operators/
+    linear_chain_crf_op).  ``input`` is the [B, T, N] emission sequence
+    (lod_level=1); the transition parameter is [N+2, N] (rows 0/1: start/
+    end scores).  Share it with `crf_decoding` via a named ParamAttr.
+    """
+    helper = LayerHelper('linear_chain_crf', **kwargs)
+    num_tags = int(input.shape[-1])
+    from ..param_attr import ParamAttr
+    transition = helper.create_parameter(
+        attr=ParamAttr.to_attr(param_attr), shape=[num_tags + 2, num_tags],
+        dtype=input.dtype, is_bias=False)
+    log_likelihood = helper.create_tmp_variable(input.dtype)
+    inputs = {'Emission': [input], 'Transition': [transition],
+              'Label': [label]}
+    inputs.update(_len_input(helper, input, 'EmissionLen'))
+    helper.append_op(
+        type='linear_chain_crf', inputs=inputs,
+        outputs={'LogLikelihood': [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, **kwargs):
+    """Viterbi decode [B, T, 1] (or per-step error indicator when `label`
+    is given).  Parity with fluid.layers.crf_decoding."""
+    helper = LayerHelper('crf_decoding', **kwargs)
+    num_tags = int(input.shape[-1])
+    from ..param_attr import ParamAttr
+    transition = helper.create_parameter(
+        attr=ParamAttr.to_attr(param_attr), shape=[num_tags + 2, num_tags],
+        dtype=input.dtype, is_bias=False)
+    viterbi_path = helper.create_tmp_variable('int64',
+                                              lod_level=input.lod_level)
+    inputs = {'Emission': [input], 'Transition': [transition]}
+    if label is not None:
+        inputs['Label'] = [label]
+    inputs.update(_len_input(helper, input, 'EmissionLen'))
+    helper.append_op(
+        type='crf_decoding', inputs=inputs,
+        outputs={'ViterbiPath': [viterbi_path]})
+    _copy_len(helper, input, viterbi_path)
+    return viterbi_path
 
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,

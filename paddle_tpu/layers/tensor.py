@@ -49,18 +49,32 @@ def cast(x, dtype, **kwargs):
 
 def concat(input, axis=0, **kwargs):
     helper = LayerHelper('concat', **locals())
-    out = helper.create_tmp_variable(helper.input_dtype())
+    # Only a feature-axis (last-dim) concat of ragged [B, T, ...] tensors
+    # keeps the inputs' lengths; time/batch concat of ragged tensors needs
+    # sequence_concat, which merges the valid steps.
+    ndim = max(len(v.shape) for v in input)
+    feature_axis = axis == -1 or axis == ndim - 1
+    lod = max(v.lod_level for v in input) if feature_axis else 0
+    out = helper.create_tmp_variable(helper.input_dtype(), lod_level=lod)
     helper.append_op(type='concat',
                      inputs={'X': input},
                      outputs={'Out': [out]},
                      attrs={'axis': axis})
+    if lod > 0:
+        ragged = next(v for v in input if v.lod_level > 0)
+        helper.copy_len(ragged, out)
     return out
 
 
 def sums(input, out=None, **kwargs):
     helper = LayerHelper('sum', **locals())
     if out is None:
-        out = helper.create_tmp_variable(helper.input_dtype())
+        lod = max(v.lod_level for v in input)
+        out = helper.create_tmp_variable(helper.input_dtype(),
+                                         lod_level=lod)
+        if lod > 0:
+            ragged = next(v for v in input if v.lod_level > 0)
+            helper.copy_len(ragged, out)
     helper.append_op(type='sum', inputs={'X': input},
                      outputs={'Out': [out]})
     return out

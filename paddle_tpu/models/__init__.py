@@ -1,0 +1,16 @@
+"""Model zoo — the reference's book chapters + benchmark nets, built on
+the paddle_tpu layers API (SURVEY.md §2.5).
+
+Each module exposes builder functions that append to the current default
+program (use inside ``fluid.program_guard`` for isolation), mirroring how
+the reference's book scripts are written.
+"""
+from . import (alexnet, fit_a_line, gan, googlenet, mnist, recommender,
+               resnet, rnn_lm, sentiment, seq2seq, smallnet, srl, vgg,
+               word2vec, ctr)
+
+__all__ = [
+    'fit_a_line', 'mnist', 'resnet', 'vgg', 'alexnet', 'googlenet',
+    'smallnet', 'word2vec', 'sentiment', 'rnn_lm', 'seq2seq', 'srl',
+    'recommender', 'ctr', 'gan',
+]

@@ -1,0 +1,26 @@
+"""M4 — AlexNet.  Reference parity: benchmark/paddle/image/alexnet.py."""
+import paddle_tpu as fluid
+
+__all__ = ['alexnet']
+
+
+def alexnet(input, num_classes=1000):
+    conv1 = fluid.layers.conv2d(
+        input=input, num_filters=64, filter_size=11, stride=4, padding=2,
+        act='relu')
+    pool1 = fluid.layers.pool2d(input=conv1, pool_size=3, pool_stride=2)
+    conv2 = fluid.layers.conv2d(
+        input=pool1, num_filters=192, filter_size=5, padding=2, act='relu')
+    pool2 = fluid.layers.pool2d(input=conv2, pool_size=3, pool_stride=2)
+    conv3 = fluid.layers.conv2d(
+        input=pool2, num_filters=384, filter_size=3, padding=1, act='relu')
+    conv4 = fluid.layers.conv2d(
+        input=conv3, num_filters=256, filter_size=3, padding=1, act='relu')
+    conv5 = fluid.layers.conv2d(
+        input=conv4, num_filters=256, filter_size=3, padding=1, act='relu')
+    pool5 = fluid.layers.pool2d(input=conv5, pool_size=3, pool_stride=2)
+    fc1 = fluid.layers.fc(input=pool5, size=4096, act='relu')
+    drop1 = fluid.layers.dropout(x=fc1, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop1, size=4096, act='relu')
+    drop2 = fluid.layers.dropout(x=fc2, dropout_prob=0.5)
+    return fluid.layers.fc(input=drop2, size=num_classes, act='softmax')

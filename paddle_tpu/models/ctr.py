@@ -1,0 +1,126 @@
+"""M11 — CTR prediction: wide&deep and DeepFM with high-dim sparse
+embedding tables (BASELINE.json config 5).
+
+TPU-native design: the sparse id features feed `lookup_table` gathers
+whose gradients come back as SelectedRows (rows, values) and are applied
+with a segment-sum — the table itself never materialises a dense gradient
+(core/selected_rows.py, ops/embedding.py).
+"""
+import paddle_tpu as fluid
+
+__all__ = ['wide_and_deep', 'deepfm', 'build']
+
+SPARSE_FEATURE_DIM = 100003  # ~1e5 hashed id space per slot
+NUM_SLOTS = 8
+DENSE_DIM = 13
+
+
+def _sparse_slots():
+    return [
+        fluid.layers.data(name='sparse_%d' % i, shape=[1], dtype='int64',
+                          lod_level=1) for i in range(NUM_SLOTS)
+    ]
+
+
+def wide_and_deep(dense, sparse_slots, label, embed_dim=16,
+                  hidden=(256, 128, 64)):
+    # deep: per-slot embeddings, sum-pooled over the slot's ids
+    embeds = [
+        fluid.layers.sequence_pool(
+            input=fluid.layers.embedding(
+                input=s, size=[SPARSE_FEATURE_DIM, embed_dim],
+                is_sparse=True, param_attr='embed_%d' % i),
+            pool_type='sum') for i, s in enumerate(sparse_slots)
+    ]
+    deep = fluid.layers.concat(input=embeds + [dense], axis=1)
+    for h in hidden:
+        deep = fluid.layers.fc(input=deep, size=h, act='relu')
+    # wide: 1-d embedding per slot (linear term over sparse ids) + dense
+    wides = [
+        fluid.layers.sequence_pool(
+            input=fluid.layers.embedding(
+                input=s, size=[SPARSE_FEATURE_DIM, 1], is_sparse=True,
+                param_attr='wide_%d' % i),
+            pool_type='sum') for i, s in enumerate(sparse_slots)
+    ]
+    wide = fluid.layers.concat(input=wides + [dense], axis=1)
+    both = fluid.layers.concat(input=[deep, wide], axis=1)
+    predict = fluid.layers.fc(input=both, size=2, act='softmax')
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    auc = fluid.layers.auc(input=predict, label=label)
+    return predict, avg_cost, auc
+
+
+def deepfm(dense, sparse_slots, label, embed_dim=16, hidden=(128, 128)):
+    """DeepFM: linear + pairwise FM interaction + deep MLP, shared
+    per-slot factor embeddings."""
+    factors = [
+        fluid.layers.sequence_pool(
+            input=fluid.layers.embedding(
+                input=s, size=[SPARSE_FEATURE_DIM, embed_dim],
+                is_sparse=True, param_attr='fm_embed_%d' % i),
+            pool_type='sum') for i, s in enumerate(sparse_slots)
+    ]
+    linear = [
+        fluid.layers.sequence_pool(
+            input=fluid.layers.embedding(
+                input=s, size=[SPARSE_FEATURE_DIM, 1], is_sparse=True,
+                param_attr='fm_w_%d' % i),
+            pool_type='sum') for i, s in enumerate(sparse_slots)
+    ]
+    # FM second-order: 0.5 * ((sum v)^2 - sum v^2), summed over factor dim
+    stacked = fluid.layers.sums(input=factors)  # [B, K]
+    sum_sq = fluid.layers.elementwise_mul(x=stacked, y=stacked)
+    sq_sum = fluid.layers.sums(
+        input=[fluid.layers.elementwise_mul(x=f, y=f) for f in factors])
+    fm2 = fluid.layers.scale(
+        x=fluid.layers.reduce_sum(
+            fluid.layers.elementwise_sub(x=sum_sq, y=sq_sum),
+            dim=1, keep_dim=True),
+        scale=0.5)
+    deep = fluid.layers.concat(input=factors + [dense], axis=1)
+    for h in hidden:
+        deep = fluid.layers.fc(input=deep, size=h, act='relu')
+    head = fluid.layers.concat(input=linear + [fm2, deep, dense], axis=1)
+    predict = fluid.layers.fc(input=head, size=2, act='softmax')
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    auc = fluid.layers.auc(input=predict, label=label)
+    return predict, avg_cost, auc
+
+
+def build(arch='wide_and_deep'):
+    """Returns (feed vars, predict, avg_cost, auc)."""
+    dense = fluid.layers.data(name='dense', shape=[DENSE_DIM],
+                              dtype='float32')
+    sparse_slots = _sparse_slots()
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    fn = {'wide_and_deep': wide_and_deep, 'deepfm': deepfm}[arch]
+    predict, avg_cost, auc = fn(dense, sparse_slots, label)
+    return [dense] + sparse_slots + [label], predict, avg_cost, auc
+
+
+def synthetic_reader(split='train', size=4096):
+    """CTR samples: (dense[13], 8 sparse id lists, label) — label is a
+    noisy function of planted id/dense interactions."""
+    import numpy as np
+    from ..datasets import common
+
+    def reader():
+        rng = common.rng_for('ctr', split)
+        w = common.rng_for('ctr', 'coef').normal(size=DENSE_DIM)
+        for _ in range(common.data_size(size)):
+            dense = rng.normal(size=DENSE_DIM).astype(np.float32)
+            slots = []
+            score = float(dense @ w)
+            for i in range(NUM_SLOTS):
+                n_ids = int(rng.integers(1, 4))
+                ids = rng.integers(0, SPARSE_FEATURE_DIM,
+                                   size=n_ids).astype(np.int64)
+                slots.append(ids.tolist())
+                score += 0.3 * np.sum((ids % 17) - 8) / 8.0
+            label = int(score + rng.normal() > 0)
+            yield tuple([dense] + slots + [label])
+
+    return reader

@@ -1,0 +1,17 @@
+"""M1 — linear regression on uci_housing.
+
+Reference parity: python/paddle/v2/fluid/tests/book/test_fit_a_line.py.
+"""
+import paddle_tpu as fluid
+
+__all__ = ['build']
+
+
+def build():
+    """Returns (x, y, y_predict, avg_cost)."""
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(x=cost)
+    return x, y, y_predict, avg_cost

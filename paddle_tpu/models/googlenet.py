@@ -1,0 +1,57 @@
+"""M4 — GoogLeNet (Inception-v1).
+
+Reference parity: benchmark/paddle/image/googlenet.py.
+"""
+import paddle_tpu as fluid
+
+__all__ = ['googlenet']
+
+
+def inception(input, c1, c3r, c3, c5r, c5, proj):
+    conv1 = fluid.layers.conv2d(
+        input=input, num_filters=c1, filter_size=1, act='relu')
+    conv3r = fluid.layers.conv2d(
+        input=input, num_filters=c3r, filter_size=1, act='relu')
+    conv3 = fluid.layers.conv2d(
+        input=conv3r, num_filters=c3, filter_size=3, padding=1, act='relu')
+    conv5r = fluid.layers.conv2d(
+        input=input, num_filters=c5r, filter_size=1, act='relu')
+    conv5 = fluid.layers.conv2d(
+        input=conv5r, num_filters=c5, filter_size=5, padding=2, act='relu')
+    pool = fluid.layers.pool2d(
+        input=input, pool_size=3, pool_stride=1, pool_padding=1)
+    convprj = fluid.layers.conv2d(
+        input=pool, num_filters=proj, filter_size=1, act='relu')
+    return fluid.layers.concat([conv1, conv3, conv5, convprj], axis=1)
+
+
+def googlenet(input, num_classes=1000):
+    conv = fluid.layers.conv2d(
+        input=input, num_filters=64, filter_size=7, stride=2, padding=3,
+        act='relu')
+    pool = fluid.layers.pool2d(
+        input=conv, pool_size=3, pool_stride=2, pool_type='max')
+    conv = fluid.layers.conv2d(
+        input=pool, num_filters=64, filter_size=1, act='relu')
+    conv = fluid.layers.conv2d(
+        input=conv, num_filters=192, filter_size=3, padding=1, act='relu')
+    pool = fluid.layers.pool2d(
+        input=conv, pool_size=3, pool_stride=2, pool_type='max')
+
+    ince3a = inception(pool, 64, 96, 128, 16, 32, 32)
+    ince3b = inception(ince3a, 128, 128, 192, 32, 96, 64)
+    pool3 = fluid.layers.pool2d(
+        input=ince3b, pool_size=3, pool_stride=2, pool_type='max')
+    ince4a = inception(pool3, 192, 96, 208, 16, 48, 64)
+    ince4b = inception(ince4a, 160, 112, 224, 24, 64, 64)
+    ince4c = inception(ince4b, 128, 128, 256, 24, 64, 64)
+    ince4d = inception(ince4c, 112, 144, 288, 32, 64, 64)
+    ince4e = inception(ince4d, 256, 160, 320, 32, 128, 128)
+    pool4 = fluid.layers.pool2d(
+        input=ince4e, pool_size=3, pool_stride=2, pool_type='max')
+    ince5a = inception(pool4, 256, 160, 320, 32, 128, 128)
+    ince5b = inception(ince5a, 384, 192, 384, 48, 128, 128)
+    pool5 = fluid.layers.pool2d(
+        input=ince5b, pool_size=7, pool_type='avg', global_pooling=True)
+    drop = fluid.layers.dropout(x=pool5, dropout_prob=0.4)
+    return fluid.layers.fc(input=drop, size=num_classes, act='softmax')

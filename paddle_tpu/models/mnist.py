@@ -1,0 +1,37 @@
+"""M2 — recognize_digits MLP + conv on MNIST.
+
+Reference parity: fluid/tests/book/test_recognize_digits_{mlp,conv}.py.
+"""
+import paddle_tpu as fluid
+
+__all__ = ['mlp', 'convnet', 'build']
+
+
+def mlp(img, label):
+    hidden1 = fluid.layers.fc(input=img, size=128, act='relu')
+    hidden2 = fluid.layers.fc(input=hidden1, size=64, act='relu')
+    prediction = fluid.layers.fc(input=hidden2, size=10, act='softmax')
+    return prediction
+
+
+def convnet(img, label):
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act="relu")
+    prediction = fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
+    return prediction
+
+
+def build(nn_type='conv'):
+    """Returns (img, label, prediction, avg_cost, acc)."""
+    img = fluid.layers.data(name='img', shape=[1, 28, 28], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    net = convnet if nn_type == 'conv' else mlp
+    prediction = net(img, label)
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return img, label, prediction, avg_cost, acc

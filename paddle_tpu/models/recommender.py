@@ -1,0 +1,94 @@
+"""M10 — recommender system on MovieLens.
+
+Reference parity: fluid/tests/book/test_recommender_system.py — user/movie
+feature fusion networks + cos_sim, squared-error regression on the scaled
+rating.
+"""
+import paddle_tpu as fluid
+from ..datasets import movielens
+
+__all__ = ['build']
+
+
+def get_usr_combined_features():
+    USR_DICT_SIZE = movielens.max_user_id() + 1
+    uid = fluid.layers.data(name='user_id', shape=[1], dtype='int64')
+    usr_emb = fluid.layers.embedding(
+        input=uid, dtype='float32', size=[USR_DICT_SIZE, 32],
+        param_attr='user_table', is_sparse=True)
+    usr_fc = fluid.layers.fc(input=usr_emb, size=32)
+
+    USR_GENDER_DICT_SIZE = 2
+    usr_gender_id = fluid.layers.data(name='gender_id', shape=[1],
+                                      dtype='int64')
+    usr_gender_emb = fluid.layers.embedding(
+        input=usr_gender_id, size=[USR_GENDER_DICT_SIZE, 16],
+        param_attr='gender_table', is_sparse=True)
+    usr_gender_fc = fluid.layers.fc(input=usr_gender_emb, size=16)
+
+    USR_AGE_DICT_SIZE = len(movielens.age_table)
+    usr_age_id = fluid.layers.data(name='age_id', shape=[1], dtype="int64")
+    usr_age_emb = fluid.layers.embedding(
+        input=usr_age_id, size=[USR_AGE_DICT_SIZE, 16], is_sparse=True,
+        param_attr='age_table')
+    usr_age_fc = fluid.layers.fc(input=usr_age_emb, size=16)
+
+    USR_JOB_DICT_SIZE = movielens.max_job_id() + 1
+    usr_job_id = fluid.layers.data(name='job_id', shape=[1], dtype="int64")
+    usr_job_emb = fluid.layers.embedding(
+        input=usr_job_id, size=[USR_JOB_DICT_SIZE, 16],
+        param_attr='job_table', is_sparse=True)
+    usr_job_fc = fluid.layers.fc(input=usr_job_emb, size=16)
+
+    concat_embed = fluid.layers.concat(
+        input=[usr_fc, usr_gender_fc, usr_age_fc, usr_job_fc], axis=1)
+    return fluid.layers.fc(input=concat_embed, size=200, act="tanh")
+
+
+def get_mov_combined_features():
+    MOV_DICT_SIZE = movielens.max_movie_id() + 1
+    mov_id = fluid.layers.data(name='movie_id', shape=[1], dtype='int64')
+    mov_emb = fluid.layers.embedding(
+        input=mov_id, dtype='float32', size=[MOV_DICT_SIZE, 32],
+        param_attr='movie_table', is_sparse=True)
+    mov_fc = fluid.layers.fc(input=mov_emb, size=32)
+
+    CATEGORY_DICT_SIZE = len(movielens.movie_categories())
+    category_id = fluid.layers.data(name='category_id', shape=[1],
+                                    dtype='int64', lod_level=1)
+    mov_categories_emb = fluid.layers.embedding(
+        input=category_id, size=[CATEGORY_DICT_SIZE, 32], is_sparse=True)
+    mov_categories_hidden = fluid.layers.sequence_pool(
+        input=mov_categories_emb, pool_type="sum")
+
+    MOV_TITLE_DICT_SIZE = len(movielens.get_movie_title_dict())
+    mov_title_id = fluid.layers.data(name='movie_title', shape=[1],
+                                     dtype='int64', lod_level=1)
+    mov_title_emb = fluid.layers.embedding(
+        input=mov_title_id, size=[MOV_TITLE_DICT_SIZE, 32], is_sparse=True)
+    mov_title_conv = fluid.nets.sequence_conv_pool(
+        input=mov_title_emb, num_filters=32, filter_size=3, act="tanh",
+        pool_type="sum")
+
+    concat_embed = fluid.layers.concat(
+        input=[mov_fc, mov_categories_hidden, mov_title_conv], axis=1)
+    return fluid.layers.fc(input=concat_embed, size=200, act="tanh")
+
+
+def build():
+    """Returns (feed_order, scale_infer, avg_cost).  Feed order matches the
+    movielens reader's 8 slots."""
+    usr_combined_features = get_usr_combined_features()
+    mov_combined_features = get_mov_combined_features()
+
+    inference = fluid.layers.cos_sim(X=usr_combined_features,
+                                     Y=mov_combined_features)
+    scale_infer = fluid.layers.scale(x=inference, scale=5.0)
+
+    label = fluid.layers.data(name='score', shape=[1], dtype='float32')
+    square_cost = fluid.layers.square_error_cost(input=scale_infer,
+                                                 label=label)
+    avg_cost = fluid.layers.mean(x=square_cost)
+    feed_order = ['user_id', 'gender_id', 'age_id', 'job_id', 'movie_id',
+                  'category_id', 'movie_title', 'score']
+    return feed_order, scale_infer, avg_cost

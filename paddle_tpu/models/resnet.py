@@ -1,0 +1,106 @@
+"""M3 flagship — ResNet for CIFAR (reference book
+image_classification resnet_cifar10) and ImageNet (reference
+benchmark/paddle/image/resnet.py: depth 18/34/50/101/152).
+
+TPU notes: 3x3/1x1 convs land on the MXU via lax.conv_general_dilated;
+train with dtype='bfloat16' activations (batch_norm keeps fp32 stats) for
+the bench path; XLA fuses the bn+relu chains into the conv epilogues.
+"""
+import paddle_tpu as fluid
+
+__all__ = ['resnet_cifar10', 'resnet_imagenet', 'build_imagenet']
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
+                  bias_attr=False):
+    tmp = fluid.layers.conv2d(
+        input=input,
+        filter_size=filter_size,
+        num_filters=ch_out,
+        stride=stride,
+        padding=padding,
+        act=None,
+        bias_attr=bias_attr)
+    return fluid.layers.batch_norm(input=tmp, act=act)
+
+
+def shortcut(input, ch_in, ch_out, stride):
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+    return input
+
+
+def basicblock(input, ch_in, ch_out, stride):
+    short = shortcut(input, ch_in, ch_out, stride)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+    return fluid.layers.elementwise_add(x=short, y=conv2, act='relu')
+
+
+def bottleneck(input, ch_in, ch_out, stride):
+    short = shortcut(input, ch_in, ch_out * 4, stride)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+    return fluid.layers.elementwise_add(x=short, y=conv3, act='relu')
+
+
+def layer_warp(block_func, input, ch_in, ch_out, count, stride):
+    res_out = block_func(input, ch_in, ch_out, stride)
+    ch_in = ch_out * (4 if block_func is bottleneck else 1)
+    for _ in range(1, count):
+        res_out = block_func(res_out, ch_in, ch_out, 1)
+    return res_out
+
+
+def resnet_cifar10(ipt, depth=32, num_classes=10):
+    """Reference: book/.../image_classification resnet_cifar10 (depth 32)."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(ipt, ch_out=16, filter_size=3, stride=1, padding=1)
+    res1 = layer_warp(basicblock, conv1, 16, 16, n, 1)
+    res2 = layer_warp(basicblock, res1, 16, 32, n, 2)
+    res3 = layer_warp(basicblock, res2, 32, 64, n, 2)
+    pool = fluid.layers.pool2d(
+        input=res3, pool_size=8, pool_type='avg', pool_stride=1)
+    return fluid.layers.fc(input=pool, size=num_classes, act='softmax')
+
+
+_DEPTH_CFG = {
+    18: (basicblock, [2, 2, 2, 2]),
+    34: (basicblock, [3, 4, 6, 3]),
+    50: (bottleneck, [3, 4, 6, 3]),
+    101: (bottleneck, [3, 4, 23, 3]),
+    152: (bottleneck, [3, 8, 36, 3]),
+}
+
+
+def resnet_imagenet(input, depth=50, num_classes=1000):
+    """Reference: benchmark/paddle/image/resnet.py (ImageNet layout)."""
+    block, counts = _DEPTH_CFG[depth]
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                          padding=3)
+    pool1 = fluid.layers.pool2d(
+        input=conv1, pool_size=3, pool_stride=2, pool_padding=1,
+        pool_type='max')
+    ch_in = 64
+    out = pool1
+    for i, (ch_out, count) in enumerate(zip([64, 128, 256, 512], counts)):
+        stride = 1 if i == 0 else 2
+        out = layer_warp(block, out, ch_in, ch_out, count, stride)
+        ch_in = ch_out * (4 if block is bottleneck else 1)
+    pool2 = fluid.layers.pool2d(
+        input=out, pool_size=7, pool_type='avg', global_pooling=True)
+    return fluid.layers.fc(input=pool2, size=num_classes, act='softmax')
+
+
+def build_imagenet(depth=50, num_classes=1000, image_shape=(3, 224, 224)):
+    """Returns (img, label, prediction, avg_cost, acc) — the bench model."""
+    img = fluid.layers.data(name='img', shape=list(image_shape),
+                            dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    prediction = resnet_imagenet(img, depth=depth, num_classes=num_classes)
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return img, label, prediction, avg_cost, acc

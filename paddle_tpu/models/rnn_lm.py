@@ -1,0 +1,32 @@
+"""M7 — stacked-LSTM language model.
+
+Reference parity: benchmark/paddle/rnn/rnn.py (LSTM LM over imdb/PTB-style
+sequences, next-token prediction).
+"""
+import paddle_tpu as fluid
+
+__all__ = ['build']
+
+
+def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2):
+    """Returns (src, target, avg_cost).  src/target are token-id sequences
+    (lod_level=1); target is src shifted by one."""
+    src = fluid.layers.data(name='src', shape=[1], dtype='int64',
+                            lod_level=1)
+    target = fluid.layers.data(name='target', shape=[1], dtype='int64',
+                               lod_level=1)
+    emb = fluid.layers.embedding(input=src, size=[vocab_size, emb_dim])
+    x = emb
+    for i in range(num_layers):
+        fc = fluid.layers.fc(input=x, size=hidden_dim * 4,
+                             num_flatten_dims=2)
+        h, _ = fluid.layers.dynamic_lstm(input=fc, size=hidden_dim * 4)
+        x = h
+    logits = fluid.layers.fc(input=x, size=vocab_size, num_flatten_dims=2,
+                             act='softmax')
+    cost = fluid.layers.cross_entropy(input=logits, label=target,
+                                      soft_label=False)
+    # mask out padded steps via sequence-average
+    avg_cost = fluid.layers.mean(
+        x=fluid.layers.sequence_pool(input=cost, pool_type='average'))
+    return src, target, avg_cost

@@ -1,0 +1,78 @@
+"""M6 — understand_sentiment on IMDB: conv net, dynamic LSTM, and the
+stacked bidirectional LSTM.
+
+Reference parity: fluid/tests/book/test_understand_sentiment_{conv,
+dynamic_lstm,lstm}.py.
+"""
+import paddle_tpu as fluid
+
+__all__ = ['convolution_net', 'dynamic_lstm_net', 'stacked_lstm_net',
+           'build']
+
+
+def convolution_net(data, label, input_dim, class_dim=2, emb_dim=32,
+                    hid_dim=32):
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+    conv_3 = fluid.nets.sequence_conv_pool(
+        input=emb, num_filters=hid_dim, filter_size=3, act="tanh",
+        pool_type="sqrt")
+    conv_4 = fluid.nets.sequence_conv_pool(
+        input=emb, num_filters=hid_dim, filter_size=4, act="tanh",
+        pool_type="sqrt")
+    prediction = fluid.layers.fc(input=[conv_3, conv_4], size=class_dim,
+                                 act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+def dynamic_lstm_net(data, label, input_dim, class_dim=2, emb_dim=32,
+                     lstm_size=32):
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+    fc0 = fluid.layers.fc(input=emb, size=lstm_size * 4, num_flatten_dims=2)
+    lstm_h, _ = fluid.layers.dynamic_lstm(
+        input=fc0, size=lstm_size * 4, is_reverse=False)
+    lstm_max = fluid.layers.sequence_pool(input=lstm_h, pool_type='max')
+    prediction = fluid.layers.fc(input=lstm_max, size=class_dim,
+                                 act='softmax')
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+def stacked_lstm_net(data, label, input_dim, class_dim=2, emb_dim=128,
+                     hid_dim=512, stacked_num=3):
+    assert stacked_num % 2 == 1
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+
+    fc1 = fluid.layers.fc(input=emb, size=hid_dim, num_flatten_dims=2)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=hid_dim)
+
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=hid_dim, num_flatten_dims=2)
+        lstm, cell = fluid.layers.dynamic_lstm(
+            input=fc, size=hid_dim, is_reverse=(i % 2) == 0)
+        inputs = [fc, lstm]
+
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type='max')
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1], pool_type='max')
+    prediction = fluid.layers.fc(
+        input=[fc_last, lstm_last], size=class_dim, act='softmax')
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+def build(input_dim, net='conv', class_dim=2):
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    fn = {'conv': convolution_net, 'dynamic_lstm': dynamic_lstm_net,
+          'stacked_lstm': stacked_lstm_net}[net]
+    avg_cost, acc, prediction = fn(data, label, input_dim,
+                                   class_dim=class_dim)
+    return data, label, avg_cost, acc, prediction

@@ -1,0 +1,75 @@
+"""M8 — machine translation: seq2seq encoder-decoder with attention.
+
+Reference parity: fluid/tests/book/test_machine_translation.py (GRU
+encoder, attention decoder, beam-search generation on WMT14).
+
+TPU-native design note: the reference's decoder is a DynamicRNN that
+re-computes attention per interpreted step.  Here training-time attention
+is the batched Luong form — decoder GRU runs over the whole (teacher
+-forced) target in one `lax.scan`, then attention over the padded encoder
+states is ONE [B,Td,H]x[B,H,Ts] matmul (MXU) with a length mask — which is
+mathematically the same attention, but rides two large matmuls instead of
+Ts small ones.  Generation-time beam search lives in
+`layers.beam_search` (static-shape scan, models/seq2seq.py: decode()).
+"""
+import paddle_tpu as fluid
+
+__all__ = ['encoder', 'train_net', 'build']
+
+
+def encoder(src_word_id, dict_size, word_dim=32, hidden_dim=32):
+    src_embedding = fluid.layers.embedding(
+        input=src_word_id, size=[dict_size, word_dim], dtype='float32')
+    fc_forward = fluid.layers.fc(
+        input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2)
+    src_forward = fluid.layers.dynamic_gru(input=fc_forward, size=hidden_dim)
+    fc_backward = fluid.layers.fc(
+        input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2)
+    src_backward = fluid.layers.dynamic_gru(
+        input=fc_backward, size=hidden_dim, is_reverse=True)
+    encoded = fluid.layers.concat(input=[src_forward, src_backward], axis=2)
+    return encoded
+
+
+def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32):
+    encoded = encoder(src, dict_size, word_dim, hidden_dim)
+
+    # decoder init state from the encoder's last step
+    enc_last = fluid.layers.sequence_last_step(input=encoded)
+    dec_h0 = fluid.layers.fc(input=enc_last, size=hidden_dim, act='tanh')
+
+    trg_embedding = fluid.layers.embedding(
+        input=trg, size=[dict_size, word_dim], dtype='float32')
+    dec_fc = fluid.layers.fc(
+        input=trg_embedding, size=hidden_dim * 3, num_flatten_dims=2)
+    dec_out = fluid.layers.dynamic_gru(
+        input=dec_fc, size=hidden_dim, h_0=dec_h0)
+
+    # Luong attention: scores over padded encoder states, masked softmax
+    enc_proj = fluid.layers.fc(
+        input=encoded, size=hidden_dim, num_flatten_dims=2)
+    scores = fluid.layers.matmul(dec_out, enc_proj, transpose_y=True)
+    attn = fluid.layers.sequence_softmax(
+        input=scores, length_input=encoded, axis=2)
+    context = fluid.layers.matmul(attn, encoded)
+    combined = fluid.layers.concat(input=[dec_out, context], axis=2)
+
+    prediction = fluid.layers.fc(
+        input=combined, size=dict_size, num_flatten_dims=2, act='softmax')
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(
+        x=fluid.layers.sequence_pool(input=cost, pool_type='sum'))
+    return prediction, avg_cost
+
+
+def build(dict_size, word_dim=32, hidden_dim=32):
+    """Returns (src, trg, label, prediction, avg_cost)."""
+    src = fluid.layers.data(name='src_word_id', shape=[1], dtype='int64',
+                            lod_level=1)
+    trg = fluid.layers.data(name='target_language_word', shape=[1],
+                            dtype='int64', lod_level=1)
+    label = fluid.layers.data(name='target_language_next_word', shape=[1],
+                              dtype='int64', lod_level=1)
+    prediction, avg_cost = train_net(src, trg, label, dict_size, word_dim,
+                                     hidden_dim)
+    return src, trg, label, prediction, avg_cost

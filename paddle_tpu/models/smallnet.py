@@ -1,0 +1,22 @@
+"""M4 — SmallNet.  Reference parity:
+benchmark/paddle/image/smallnet_mnist_cifar.py (small conv net)."""
+import paddle_tpu as fluid
+
+__all__ = ['smallnet']
+
+
+def smallnet(input, num_classes=10):
+    conv1 = fluid.layers.conv2d(
+        input=input, num_filters=32, filter_size=5, padding=2, act='relu')
+    pool1 = fluid.layers.pool2d(input=conv1, pool_size=3, pool_stride=2,
+                                pool_type='max')
+    conv2 = fluid.layers.conv2d(
+        input=pool1, num_filters=32, filter_size=5, padding=2, act='relu')
+    pool2 = fluid.layers.pool2d(input=conv2, pool_size=3, pool_stride=2,
+                                pool_type='avg')
+    conv3 = fluid.layers.conv2d(
+        input=pool2, num_filters=64, filter_size=5, padding=2, act='relu')
+    pool3 = fluid.layers.pool2d(input=conv3, pool_size=3, pool_stride=2,
+                                pool_type='avg')
+    fc1 = fluid.layers.fc(input=pool3, size=64, act='relu')
+    return fluid.layers.fc(input=fc1, size=num_classes, act='softmax')

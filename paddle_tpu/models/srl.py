@@ -1,0 +1,102 @@
+"""M9 — label_semantic_roles: deep bidirectional LSTM + CRF on CoNLL05.
+
+Reference parity: fluid/tests/book/test_label_semantic_roles.py (8 input
+sequences, stacked alternating-direction LSTMs, linear_chain_crf loss,
+crf_decoding inference).
+"""
+import paddle_tpu as fluid
+
+__all__ = ['db_lstm', 'build']
+
+word_dim = 32
+mark_dim = 5
+hidden_dim = 512
+depth = 4
+mix_hidden_lr = 1e-3
+
+
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark,
+            word_dict_len, pred_dict_len, mark_dict_len, label_dict_len):
+    predicate_embedding = fluid.layers.embedding(
+        input=predicate, size=[pred_dict_len, word_dim],
+        dtype='float32', param_attr='vemb')
+    mark_embedding = fluid.layers.embedding(
+        input=mark, size=[mark_dict_len, mark_dim], dtype='float32')
+
+    word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    emb_layers = [
+        fluid.layers.embedding(
+            size=[word_dict_len, word_dim], input=x,
+            param_attr=fluid.ParamAttr(name='word_emb', trainable=False))
+        for x in word_input
+    ]
+    emb_layers.append(predicate_embedding)
+    emb_layers.append(mark_embedding)
+
+    hidden_0_layers = [
+        fluid.layers.fc(input=emb, size=hidden_dim, num_flatten_dims=2)
+        for emb in emb_layers
+    ]
+    hidden_0 = fluid.layers.sums(input=hidden_0_layers)
+
+    lstm_0, _ = fluid.layers.dynamic_lstm(
+        input=hidden_0, size=hidden_dim,
+        candidate_activation='relu',
+        gate_activation='sigmoid',
+        cell_activation='sigmoid')
+
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = fluid.layers.sums(input=[
+            fluid.layers.fc(input=input_tmp[0], size=hidden_dim,
+                            num_flatten_dims=2),
+            fluid.layers.fc(input=input_tmp[1], size=hidden_dim,
+                            num_flatten_dims=2)
+        ])
+        lstm, _ = fluid.layers.dynamic_lstm(
+            input=mix_hidden, size=hidden_dim,
+            candidate_activation='relu',
+            gate_activation='sigmoid',
+            cell_activation='sigmoid',
+            is_reverse=((i % 2) == 1))
+        input_tmp = [mix_hidden, lstm]
+
+    feature_out = fluid.layers.sums(input=[
+        fluid.layers.fc(input=input_tmp[0], size=label_dict_len,
+                        num_flatten_dims=2),
+        fluid.layers.fc(input=input_tmp[1], size=label_dict_len,
+                        num_flatten_dims=2)
+    ])
+    return feature_out
+
+
+def build(word_dict_len, pred_dict_len, mark_dict_len, label_dict_len):
+    """Returns (feed_order vars, feature_out, crf_decode, avg_cost)."""
+    def seq_data(name):
+        return fluid.layers.data(name=name, shape=[1], dtype='int64',
+                                 lod_level=1)
+
+    word = seq_data('word_data')
+    ctx_n2 = seq_data('ctx_n2_data')
+    ctx_n1 = seq_data('ctx_n1_data')
+    ctx_0 = seq_data('ctx_0_data')
+    ctx_p1 = seq_data('ctx_p1_data')
+    ctx_p2 = seq_data('ctx_p2_data')
+    predicate = seq_data('verb_data')
+    mark = seq_data('mark_data')
+    target = seq_data('target')
+
+    feature_out = db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+                          ctx_p2, mark, word_dict_len, pred_dict_len,
+                          mark_dict_len, label_dict_len)
+
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name='crfw', learning_rate=mix_hidden_lr))
+    avg_cost = fluid.layers.mean(x=crf_cost)
+    crf_decode = fluid.layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name='crfw'))
+
+    feeds = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark,
+             target]
+    return feeds, feature_out, crf_decode, avg_cost

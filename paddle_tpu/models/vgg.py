@@ -1,0 +1,60 @@
+"""M3 — VGG-16.
+
+Reference parity: book image_classification vgg16_bn_drop (cifar) and
+benchmark/paddle/image/vgg.py (ImageNet VGG-16/19).
+"""
+import paddle_tpu as fluid
+
+__all__ = ['vgg16_bn_drop', 'vgg_imagenet']
+
+
+def vgg16_bn_drop(input, num_classes=10):
+    def conv_block(ipt, num_filter, groups, dropouts):
+        return fluid.nets.img_conv_group(
+            input=ipt,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * groups,
+            conv_filter_size=3,
+            conv_act='relu',
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type='max')
+
+    conv1 = conv_block(input, 64, 2, [0.3, 0])
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0])
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0])
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0])
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0])
+
+    drop = fluid.layers.dropout(x=conv5, dropout_prob=0.5)
+    fc1 = fluid.layers.fc(input=drop, size=512, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act='relu')
+    drop2 = fluid.layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop2, size=512, act=None)
+    return fluid.layers.fc(input=fc2, size=num_classes, act='softmax')
+
+
+def vgg_imagenet(input, num_classes=1000, depth=16):
+    """benchmark/paddle/image/vgg.py layout (plain convs, no BN)."""
+    cfg = {16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}[depth]
+
+    def conv_block(ipt, num_filter, groups):
+        return fluid.nets.img_conv_group(
+            input=ipt,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * groups,
+            conv_filter_size=3,
+            conv_act='relu',
+            conv_with_batchnorm=False,
+            pool_type='max')
+
+    out = input
+    for num_filter, groups in zip([64, 128, 256, 512, 512], cfg):
+        out = conv_block(out, num_filter, groups)
+    fc1 = fluid.layers.fc(input=out, size=4096, act='relu')
+    drop1 = fluid.layers.dropout(x=fc1, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop1, size=4096, act='relu')
+    drop2 = fluid.layers.dropout(x=fc2, dropout_prob=0.5)
+    return fluid.layers.fc(input=drop2, size=num_classes, act='softmax')

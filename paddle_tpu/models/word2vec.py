@@ -1,0 +1,37 @@
+"""M5 — word2vec N-gram LM on imikolov.
+
+Reference parity: fluid/tests/book/test_word2vec.py (4-word context
+predicts the 5th; shared embedding table).
+"""
+import paddle_tpu as fluid
+
+__all__ = ['build']
+
+EMBED_SIZE = 32
+HIDDEN_SIZE = 256
+N = 5
+
+
+def build(dict_size):
+    """Returns (word_vars, next_word, predict, avg_cost)."""
+    names = ['firstw', 'secondw', 'thirdw', 'forthw']
+    words = [fluid.layers.data(name=n, shape=[1], dtype='int64')
+             for n in names]
+    next_word = fluid.layers.data(name='nextw', shape=[1], dtype='int64')
+
+    embeds = [
+        fluid.layers.embedding(
+            input=w,
+            size=[dict_size, EMBED_SIZE],
+            dtype='float32',
+            is_sparse=True,
+            param_attr=fluid.ParamAttr(name='shared_w')) for w in words
+    ]
+    concat_embed = fluid.layers.concat(input=embeds, axis=1)
+    hidden1 = fluid.layers.fc(input=concat_embed, size=HIDDEN_SIZE,
+                              act='sigmoid')
+    predict_word = fluid.layers.fc(input=hidden1, size=dict_size,
+                                   act='softmax')
+    cost = fluid.layers.cross_entropy(input=predict_word, label=next_word)
+    avg_cost = fluid.layers.mean(x=cost)
+    return words, next_word, predict_word, avg_cost

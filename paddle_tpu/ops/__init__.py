@@ -3,6 +3,6 @@
 Reference parity: paddle/operators/* (one jax function per reference op
 kernel family; see SURVEY.md §2.2).
 """
-from . import (activations, common, conv, ctc, embedding, loss, math,
+from . import (activations, common, conv, crf, ctc, embedding, loss, math,
                metrics, norm, optim_ops, pool, random, rnn, sequence,
                tensor_ops)  # noqa: F401

@@ -1,0 +1,140 @@
+"""Linear-chain CRF ops.
+
+Reference parity: paddle/operators/linear_chain_crf_op.{h,cc} and
+crf_decoding_op.{h,cc}.  The reference walks each LoD sequence on the
+host CPU; here emissions are padded [B, T, N] + lengths and both the
+forward (log-partition) recursion and Viterbi ride one `lax.scan` over T
+for the whole batch — masked steps carry state through unchanged, so the
+padded tail contributes nothing.
+
+Transition parameter layout (same as the reference): [N+2, N] where row 0
+holds start scores, row 1 end scores, rows 2.. the N x N transitions.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+maybe = first  # absent slot -> None
+
+__all__ = ['crf_nll', 'crf_viterbi']
+
+
+def _unpack(transition):
+    start = transition[0]
+    end = transition[1]
+    trans = transition[2:]
+    return start, end, trans
+
+
+def crf_nll(emission, lengths, transition, labels):
+    """Negative log-likelihood per sequence: [B] (fp32)."""
+    B, T, N = emission.shape
+    emission = emission.astype(jnp.float32)
+    transition = transition.astype(jnp.float32)
+    start, end, trans = _unpack(transition)
+    labels = labels.astype(jnp.int32)
+    t_idx = jnp.arange(T)
+    mask = t_idx[None, :] < lengths[:, None]  # [B, T]
+
+    # ---- log partition via forward recursion
+    alpha0 = start[None, :] + emission[:, 0, :]  # [B, N]
+
+    def fwd(alpha, inputs):
+        emit_t, m_t = inputs  # [B, N], [B]
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, N, N]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + emit_t
+        alpha = jnp.where(m_t[:, None], new, alpha)
+        return alpha, None
+
+    xs = (jnp.moveaxis(emission, 1, 0)[1:], jnp.moveaxis(mask, 1, 0)[1:])
+    alpha_T, _ = jax.lax.scan(fwd, alpha0, xs)
+    log_z = jax.scipy.special.logsumexp(alpha_T + end[None, :], axis=1)
+
+    # ---- gold path score
+    b_idx = jnp.arange(B)
+    emit_scores = jnp.take_along_axis(
+        emission, labels[:, :, None], axis=2)[..., 0]  # [B, T]
+    emit_sum = jnp.sum(jnp.where(mask, emit_scores, 0.0), axis=1)
+    prev_l, next_l = labels[:, :-1], labels[:, 1:]
+    trans_scores = trans[prev_l, next_l]  # [B, T-1]
+    trans_sum = jnp.sum(jnp.where(mask[:, 1:], trans_scores, 0.0), axis=1)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_label = labels[b_idx, last_idx]
+    gold = emit_sum + trans_sum + start[labels[:, 0]] + end[last_label]
+    return log_z - gold
+
+
+def crf_viterbi(emission, lengths, transition):
+    """Viterbi decode: returns [B, T] int32 best path (zeros past length)."""
+    B, T, N = emission.shape
+    emission = emission.astype(jnp.float32)
+    transition = transition.astype(jnp.float32)
+    start, end, trans = _unpack(transition)
+    t_idx = jnp.arange(T)
+    mask = t_idx[None, :] < lengths[:, None]
+
+    delta0 = start[None, :] + emission[:, 0, :]
+
+    def step(delta, inputs):
+        emit_t, m_t = inputs
+        scores = delta[:, :, None] + trans[None, :, :]  # [B, prev, cur]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new = jnp.max(scores, axis=1) + emit_t
+        delta_next = jnp.where(m_t[:, None], new, delta)
+        # past the end, backpointer is identity so backtrace passes through
+        bp = jnp.where(m_t[:, None], best_prev,
+                       jnp.arange(N)[None, :])
+        return delta_next, bp
+
+    xs = (jnp.moveaxis(emission, 1, 0)[1:], jnp.moveaxis(mask, 1, 0)[1:])
+    delta_T, bps = jax.lax.scan(step, delta0, xs)  # bps: [T-1, B, N]
+
+    last = jnp.argmax(delta_T + end[None, :], axis=1)  # [B]
+
+    def back(lab, bp_t):
+        prev = jnp.take_along_axis(bp_t, lab[:, None], axis=1)[:, 0]
+        return prev, lab
+
+    _, path_rev = jax.lax.scan(back, last, bps, reverse=True)
+    path = jnp.concatenate([path_rev, last[None, :]], axis=0)  # [T, B]
+    path = jnp.moveaxis(path, 0, 1).astype(jnp.int32)
+    return jnp.where(mask, path, 0)
+
+
+from .sequence import _lengths as _lengths_of_slot
+
+
+def _lengths_of(ins, key, x):
+    return _lengths_of_slot(ins, key, x)
+
+
+@register_op('linear_chain_crf')
+def _linear_chain_crf(ctx, ins, attrs):
+    emission = first(ins, 'Emission')  # [B, T, N]
+    transition = first(ins, 'Transition')  # [N+2, N]
+    label = first(ins, 'Label')  # [B, T] or [B, T, 1]
+    if label.ndim == 3:
+        label = label[..., 0]
+    lengths = _lengths_of(ins, 'EmissionLen', emission)
+    nll = crf_nll(emission, lengths, transition, label)  # [B]
+    return {'LogLikelihood': [nll[:, None]]}
+
+
+@register_op('crf_decoding')
+def _crf_decoding(ctx, ins, attrs):
+    emission = first(ins, 'Emission')
+    transition = first(ins, 'Transition')
+    lengths = _lengths_of(ins, 'EmissionLen', emission)
+    path = crf_viterbi(emission, lengths, transition)  # [B, T]
+    label = maybe(ins, 'Label')
+    if label is not None:
+        if label.ndim == 3:
+            label = label[..., 0]
+        # parity with crf_decoding_op: with Label, emit 1 where the Viterbi
+        # tag DISAGREES with the gold tag (an error indicator per step)
+        mask = jnp.arange(emission.shape[1])[None, :] < lengths[:, None]
+        err = (path != label.astype(jnp.int32)) & mask
+        return {'ViterbiPath': [err.astype(jnp.int32)[..., None]]}
+    return {'ViterbiPath': [path[..., None]]}

@@ -63,14 +63,22 @@ def _sequence_pool(ctx, ins, attrs):
 @register_op('sequence_softmax')
 def _sequence_softmax(ctx, ins, attrs):
     """Softmax over the valid time steps of each row.  Accepts [B, T] or
-    [B, T, 1] (parity: operators/sequence_softmax_op)."""
+    [B, T, 1] (parity: operators/sequence_softmax_op).  attr `axis` picks
+    the time axis masked by the lengths (axis=2 on [B, Td, Ts] scores is
+    batched attention over another sequence's steps)."""
     x = first(ins, 'X')
-    lengths = _lengths(ins, 'XLen', x)
-    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    axis = int(attrs.get('axis', 1))
+    lengths = _lengths(ins, 'XLen', x, time_axis=axis)
+    squeeze = axis == 1 and x.ndim == 3 and x.shape[-1] == 1
     xs = x[..., 0] if squeeze else x
-    mask = jnp.arange(xs.shape[1])[None, :] < lengths[:, None]
+    T = xs.shape[axis]
+    mshape = [1] * xs.ndim
+    mshape[0] = xs.shape[0]
+    mshape[axis] = T
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    mask = mask.reshape(mshape)
     logits = jnp.where(mask, xs.astype(jnp.float32), -jnp.inf)
-    y = jax.nn.softmax(logits, axis=1)
+    y = jax.nn.softmax(logits, axis=axis)
     y = jnp.where(mask, y, 0.0).astype(x.dtype)
     return out(y[..., None] if squeeze else y)
 

@@ -1,0 +1,18 @@
+"""Mesh-first distribution (SURVEY.md §2.6).
+
+Every parallel feature of the reference — nccl allreduce data parallelism
+(D1), the pserver split (D2), model/tensor parallel (D3), pipeline (D4),
+long-sequence context parallel (D5), the NCCL/MPI collective backend (D6)
+— is expressed here as a sharding over ONE `jax.sharding.Mesh` with named
+axes; XLA lowers the named-axis collectives onto ICI.
+"""
+from . import api, collective, data_parallel, pipeline, ring_attention, \
+    tensor_parallel
+from .api import (current_mesh, make_mesh, mesh_guard, run_sharded,
+                  shard_tensor)
+
+__all__ = [
+    'api', 'collective', 'data_parallel', 'tensor_parallel', 'pipeline',
+    'ring_attention', 'make_mesh', 'mesh_guard', 'current_mesh',
+    'shard_tensor', 'run_sharded',
+]

@@ -1,0 +1,100 @@
+"""Collective communication backend (D6).
+
+Reference parity: paddle/operators/nccl_op.cc (allreduce/bcast/reduce) and
+the MPI/NCCL backend — rebuilt as XLA named-axis collectives usable inside
+`shard_map` over a Mesh axis; on TPU these lower onto ICI rings.  Multi
+-host process bring-up (the reference's trainer_id/trainer_count env
+protocol) maps to jax.distributed in distributed/launch.py.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['allreduce', 'allgather', 'reduce_scatter', 'broadcast',
+           'ppermute', 'all_to_all', 'psum', 'pmean', 'pmax', 'pmin',
+           'axis_index', 'axis_size', 'barrier', 'shard_map']
+
+from jax.experimental.shard_map import shard_map  # re-export
+
+
+def psum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    return lax.pmin(x, axis_name)
+
+
+def allreduce(x, axis_name, op='sum'):
+    """nccl_op AllReduce parity (reduction=ncclSum/Prod/Min/Max)."""
+    if op == 'sum':
+        return lax.psum(x, axis_name)
+    if op == 'mean':
+        return lax.pmean(x, axis_name)
+    if op == 'max':
+        return lax.pmax(x, axis_name)
+    if op == 'min':
+        return lax.pmin(x, axis_name)
+    if op == 'prod':
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError("unsupported allreduce op %r" % op)
+
+
+def allgather(x, axis_name, axis=0, tiled=True):
+    """nccl AllGather parity: concatenate shards along `axis`."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    """ReduceScatter: sum over the axis group, then scatter along `axis` —
+    the fsdp/pserver gradient path (D2)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                            tiled=True)
+
+
+def broadcast(x, axis_name, root=0):
+    """nccl Bcast parity: every member takes root's value."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    """Point-to-point ring shift (ICI neighbour exchange) — the building
+    block of pipeline microbatch handoff (D4) and ring attention (D5)."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_shift(x, axis_name, shift=1):
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    """MPI_Alltoall parity: re-shard between sequence- and head-sharded
+    layouts (D5 sequence parallelism switch)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def barrier(axis_name):
+    """Synchronisation point: a trivial psum forces a collective (the
+    XLA analogue of ncclGroupEnd+cudaStreamSynchronize)."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name)

@@ -1,0 +1,69 @@
+"""D4 — pipeline parallelism: stage-sharded shard_map + ppermute
+microbatch handoff (GPipe schedule).
+
+Reference parity: the reference pipelines via pserver program splits;
+TPU-native pipelining keeps all stages in ONE SPMD program: each mesh
+member owns one stage's params, microbatches flow through a `lax.scan`
+whose carry ppermutes activations to the next stage each tick.  With S
+stages and M microbatches the scan runs S+M-1 ticks (bubble included).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['pipeline_apply']
+
+
+def pipeline_apply(stage_fn, params_shard, microbatches, axis_name,
+                   num_stages=None):
+    """Run a GPipe pipeline inside shard_map.
+
+    stage_fn(params, x) -> y: one stage's compute (same code every stage;
+      heterogeneous stages dispatch on params content).
+    params_shard: this member's stage params (stacked leading stage dim
+      sliced away by shard_map).
+    microbatches: [M, mb, ...] — every member sees the full stream; stage
+      0 injects microbatch t at tick t, the last stage emits outputs.
+
+    Returns [M, mb, ...] outputs (valid on the last stage; callers psum or
+    gather as needed).
+    """
+    S = num_stages if num_stages is not None else lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    total = M + S - 1
+
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        buf, outs = carry  # buf: this member's current activation
+        # stage 0 picks up microbatch t (if any remain); others keep the
+        # activation ppermuted from the previous stage
+        inject = microbatches[jnp.minimum(t, M - 1)]
+        x = jnp.where(rank == 0, jnp.where(t < M, inject, buf), buf)
+        y = stage_fn(params_shard, x)
+        # last stage records its output at tick t for microbatch t-(S-1)
+        out_idx = t - (S - 1)
+        record = (rank == S - 1) & (out_idx >= 0)
+        idx = jnp.maximum(out_idx, 0)
+        outs = outs.at[idx].set(jnp.where(record, y, outs[idx]))
+        # hand activations to the next stage
+        buf = lax.ppermute(y, axis_name, fwd_perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros(mb_shape, microbatches.dtype)
+    out_shape = jax.eval_shape(stage_fn, params_shard,
+                               jax.ShapeDtypeStruct(mb_shape,
+                                                    microbatches.dtype))
+    outs0 = jnp.zeros((M,) + out_shape.shape, out_shape.dtype)
+    # the carry varies per mesh member (each holds its stage's activation)
+    try:
+        buf0 = lax.pvary(buf0, (axis_name,))
+        outs0 = lax.pvary(outs0, (axis_name,))
+    except AttributeError:  # older jax: vma tracking absent
+        pass
+    (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
+    return outs

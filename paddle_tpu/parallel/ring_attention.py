@@ -1,0 +1,94 @@
+"""D5 — long-sequence context parallelism: ring attention over 'sp'.
+
+Reference parity: the reference handles long sequences by LoD chunking on
+one device; context parallelism is the TPU-native scale-out: Q stays put,
+K/V blocks rotate around the ring (`ppermute` rides ICI) while each member
+accumulates its softmax numerator/denominator online (flash-attention
+style running max/sum) — exact attention, O(seq/sp) memory per chip,
+compute/comm overlapped by XLA's async collective scheduling.
+
+`seq_to_heads`/`heads_to_seq` are the all-to-all layout switches (DeepSpeed
+-Ulysses style) for layers that prefer head-sharding.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['ring_attention', 'seq_to_heads', 'heads_to_seq',
+           'local_attention']
+
+
+def local_attention(q, k, v, scale=None, causal=False, q_offset=0,
+                    k_offset=0):
+    """Plain blockwise attention returning (out_unnormalised, row_max,
+    row_sum) for online-softmax accumulation.  q: [B, Tq, H, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[1])
+        ki = k_offset + jnp.arange(k.shape[1])
+        s = jnp.where(qi[:, None] >= ki[None, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B, H, Tq]
+    # guard fully-masked rows (all -inf) against nan
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B, H, Tq]
+    o = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m_safe, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Combine two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    o = o1 * a1[..., None].swapaxes(1, 2) + o2 * a2[..., None].swapaxes(1, 2)
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Exact attention with K/V sharded over `axis_name` (inside
+    shard_map).  q/k/v: [B, T/sp, H, D] local shards; returns [B, T/sp,
+    H, D]."""
+    sp = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    chunk = q.shape[1]
+    q_off = rank * chunk
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    o0, m0, l0 = local_attention(q, k, v, scale=scale, causal=causal,
+                                 q_offset=q_off, k_offset=q_off)
+
+    def step(carry, i):
+        o, m, l, kr, vr, k_owner = carry
+        kr = lax.ppermute(kr, axis_name, perm)
+        vr = lax.ppermute(vr, axis_name, perm)
+        k_owner = (k_owner - 1) % sp
+        k_off = k_owner * chunk
+        o2, m2, l2 = local_attention(q, kr, vr, scale=scale, causal=causal,
+                                     q_offset=q_off, k_offset=k_off)
+        o, m, l = _merge(o, m, l, o2, m2, l2)
+        return (o, m, l, kr, vr, k_owner), None
+
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, rank), jnp.arange(sp - 1))
+    l = jnp.maximum(l, 1e-20)
+    return (o / l[..., None].swapaxes(1, 2)).astype(q.dtype)
+
+
+def seq_to_heads(x, axis_name):
+    """[B, T/sp, H, D] -> [B, T, H/sp, D]: all_to_all switch so sequence
+    -sharded activations become head-sharded for per-head ops."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name):
+    """[B, T, H/sp, D] -> [B, T/sp, H, D] (inverse of seq_to_heads)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -1,0 +1,55 @@
+"""D3 — Megatron-style tensor parallelism as shard_map building blocks.
+
+Reference parity: model-parallel fc/embedding layers.  Column-parallel
+matmul keeps the activation sharded on features; row-parallel matmul
+psums partial products over 'tp' — one ICI allreduce per pair, the same
+schedule Megatron-LM uses.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['column_parallel_matmul', 'row_parallel_matmul',
+           'parallel_embedding', 'tp_fc_pair']
+
+
+def column_parallel_matmul(x, w_shard, b_shard=None):
+    """x: [B, D] replicated; w_shard: [D, H/tp] this member's columns.
+    Returns [B, H/tp] (feature-sharded); no communication."""
+    y = jnp.dot(x, w_shard, preferred_element_type=jnp.float32)
+    if b_shard is not None:
+        y = y + b_shard
+    return y.astype(x.dtype)
+
+
+def row_parallel_matmul(x_shard, w_shard, axis_name, b=None):
+    """x_shard: [B, D/tp]; w_shard: [D/tp, H].  psum over `axis_name`
+    completes the contraction; bias adds once (post-reduce)."""
+    partial = jnp.dot(x_shard, w_shard, preferred_element_type=jnp.float32)
+    y = lax.psum(partial, axis_name)
+    if b is not None:
+        y = y + b
+    return y.astype(x_shard.dtype)
+
+
+def parallel_embedding(ids, table_shard, axis_name):
+    """Vocab-sharded embedding: each member owns rows
+    [rank*V/tp, (rank+1)*V/tp); out-of-range ids contribute zeros and the
+    psum assembles the full gather."""
+    tp = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    vshard = table_shard.shape[0]
+    lo = rank * vshard
+    local = ids - lo
+    in_range = (local >= 0) & (local < vshard)
+    safe = jnp.clip(local, 0, vshard - 1)
+    emb = table_shard[safe]
+    emb = jnp.where(in_range[..., None], emb, 0)
+    return lax.psum(emb, axis_name)
+
+
+def tp_fc_pair(x, w1_shard, w2_shard, axis_name, act=jax.nn.relu):
+    """The canonical Megatron block: column-parallel fc + act +
+    row-parallel fc = ONE psum for two matmuls."""
+    h = act(column_parallel_matmul(x, w1_shard))
+    return row_parallel_matmul(h, w2_shard, axis_name)

@@ -1,0 +1,18 @@
+"""Reader pipelines — composable python iterator factories.
+
+Reference parity: python/paddle/v2/reader (decorator.py) and
+python/paddle/v2/minibatch.py.  A *reader creator* is a zero-arg callable
+returning an iterator over samples; decorators wrap creators.  On TPU the
+hot path is fed by the native C++ prefetcher (paddle_tpu/runtime/native.py)
+behind `xmap_readers`/`buffered`; these decorators remain pure-python
+fallbacks with identical semantics.
+"""
+from .decorator import (map_readers, buffered, compose, chain, shuffle,
+                        firstn, xmap_readers, cache, PipeReader,
+                        ComposeNotAligned)
+from .minibatch import batch
+
+__all__ = [
+    'map_readers', 'buffered', 'compose', 'chain', 'shuffle', 'firstn',
+    'xmap_readers', 'cache', 'PipeReader', 'ComposeNotAligned', 'batch',
+]

@@ -1,0 +1,262 @@
+"""Reader decorators.
+
+Reference parity: python/paddle/v2/reader/decorator.py (map_readers,
+shuffle, chain, compose, buffered, firstn, xmap_readers, PipeReader).
+Same contracts; implementation is plain python threading — the heavy
+multi-process machinery the reference needs for CPU-bound python feeds is
+replaced by the native C++ prefetcher for the TPU input pipeline (see
+paddle_tpu/runtime/native.py), with these as the portable fallback.
+"""
+import itertools
+import random
+import subprocess
+import threading
+import queue as _queue
+
+__all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'firstn', 'xmap_readers', 'cache', 'PipeReader',
+           'ComposeNotAligned']
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    """Creator whose samples are ``func(r1_sample, r2_sample, ...)``."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of ``buf_size`` samples."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers: all of r1, then all of r2, ..."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined tuples.  With check_alignment=True
+    (default) raises ComposeNotAligned if they end at different times."""
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                for o in outputs:
+                    if o is None:
+                        raise ComposeNotAligned(
+                            "outputs of readers are not aligned.")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Pre-read up to ``size`` samples into a queue on a worker thread."""
+
+    class EndSignal(object):
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        try:
+            for d in r:
+                q.put(d)
+        finally:
+            q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Only the first ``n`` samples."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def cache(reader):
+    """Materialise the reader once; replay from memory thereafter."""
+    all_data = []
+    filled = []
+
+    def cache_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        for item in all_data:
+            yield item
+
+    return cache_reader
+
+
+class XmapEndSignal(object):
+    pass
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel ``map``: ``process_num`` worker threads apply ``mapper``
+    over samples with a bounded queue of ``buffer_size``.
+
+    Reference parity: decorator.py xmap_readers (threads there too).  When
+    the native runtime is built, the same contract is served by the C++
+    thread pool (runtime/native.py: NativeXmap) — this is the fallback.
+    """
+    end = XmapEndSignal()
+
+    def read_worker(r, in_q):
+        for i in r():
+            in_q.put(i)
+        in_q.put(end)
+
+    def order_read_worker(r, in_q):
+        for i, d in enumerate(r()):
+            in_q.put((i, d))
+        in_q.put(end)
+
+    def handle_worker(in_q, out_q, mapper):
+        sample = in_q.get()
+        while not isinstance(sample, XmapEndSignal):
+            out_q.put(mapper(sample))
+            sample = in_q.get()
+        in_q.put(end)
+        out_q.put(end)
+
+    def order_handle_worker(in_q, out_q, mapper, out_order):
+        ins = in_q.get()
+        while not isinstance(ins, XmapEndSignal):
+            order, sample = ins
+            result = mapper(sample)
+            while order != out_order[0]:
+                pass
+            out_q.put(result)
+            out_order[0] += 1
+            ins = in_q.get()
+        in_q.put(end)
+        out_q.put(end)
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        out_order = [0]
+        target = order_read_worker if order else read_worker
+        t = threading.Thread(target=target, args=(reader, in_q))
+        t.daemon = True
+        t.start()
+        target = order_handle_worker if order else handle_worker
+        args = (in_q, out_q, mapper, out_order) if order else \
+            (in_q, out_q, mapper)
+        workers = []
+        for _ in range(process_num):
+            w = threading.Thread(target=target, args=args)
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        finish = 0
+        while finish < process_num:
+            sample = out_q.get()
+            if isinstance(sample, XmapEndSignal):
+                finish += 1
+            else:
+                yield sample
+
+    return xreader
+
+
+class PipeReader(object):
+    """Stream samples out of a shell command's stdout (reference:
+    decorator.py PipeReader — used for HDFS cat pipelines)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("left_cmd must be a string")
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        if file_type == "gzip":
+            import zlib
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        self.process = subprocess.Popen(
+            self.command.split(" "), bufsize=self.bufsize,
+            stdout=subprocess.PIPE)
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if buff:
+                if self.file_type == "gzip":
+                    decomp_buff = self.dec.decompress(buff).decode('utf-8',
+                                                                   'ignore')
+                elif self.file_type == "plain":
+                    decomp_buff = buff.decode('utf-8', 'ignore')
+                else:
+                    raise TypeError("file_type %s is not allowed" %
+                                    self.file_type)
+                if cut_lines:
+                    lines = (remained + decomp_buff).split(line_break)
+                    remained = lines.pop(-1)
+                    for line in lines:
+                        yield line
+                else:
+                    yield decomp_buff
+            else:
+                if remained:
+                    yield remained
+                break

@@ -1,0 +1,26 @@
+"""Minibatching.  Reference parity: python/paddle/v2/minibatch.py."""
+
+__all__ = ['batch']
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group a sample reader into a minibatch reader (lists of samples).
+
+    On TPU, fixed batch shapes avoid re-jitting the step program, so
+    ``drop_last=True`` is the recommended setting for training loops (the
+    executor still handles a ragged tail batch — it just compiles a second
+    program for the tail shape).
+    """
+
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

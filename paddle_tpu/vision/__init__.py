@@ -1,0 +1,3 @@
+from . import image  # noqa: F401
+
+__all__ = ['image']

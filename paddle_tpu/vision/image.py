@@ -1,0 +1,106 @@
+"""Image pre-processing utilities (numpy; no cv2 dependency).
+
+Reference parity: python/paddle/v2/image.py — load/resize/crop/flip/
+normalize helpers used by the dataset mappers (simple_transform,
+load_and_transform).  Host-side augmentation stays on CPU; on TPU the
+normalized batch is the feed, everything after is in the jitted program.
+"""
+import numpy as np
+
+__all__ = [
+    'resize_short', 'to_chw', 'center_crop', 'random_crop', 'left_right_flip',
+    'simple_transform', 'load_and_transform', 'batch_images'
+]
+
+
+def _bilinear_resize(im, h, w):
+    """im: HWC float/uint8 -> HWC resized (numpy bilinear)."""
+    ih, iw = im.shape[:2]
+    if (ih, iw) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+    y1 = np.clip(y0 + 1, 0, ih - 1)
+    x1 = np.clip(x0 + 1, 0, iw - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = im.astype(np.float32)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge equals ``size`` (keeps aspect)."""
+    h, w = im.shape[:2]
+    if h > w:
+        h_new, w_new = size * h // w, size
+    else:
+        h_new, w_new = size, size * w // h
+    return _bilinear_resize(im, h_new, w_new)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h_start = int(rng.randint(0, max(1, h - size + 1)))
+    w_start = int(rng.randint(0, max(1, w - size + 1)))
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short + crop (+ random flip when training) + CHW + mean-sub.
+
+    Reference: image.py simple_transform.
+    """
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, dtype=np.float32)
+        im -= mean if mean.ndim != 1 else mean[:, None, None]
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    try:
+        from PIL import Image
+        im = np.asarray(Image.open(filename))
+    except ImportError as e:
+        raise RuntimeError("loading image files requires PIL") from e
+    return simple_transform(im, resize_size, crop_size, is_train, is_color,
+                            mean)
+
+
+def batch_images(samples):
+    """Stack a list of CHW images into an NCHW batch."""
+    return np.stack([np.asarray(s, dtype=np.float32) for s in samples])

@@ -1,0 +1,29 @@
+"""End-to-end: CTR wide&deep + DeepFM train on synthetic click data
+(BASELINE.json config 5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+@pytest.mark.parametrize('arch', ['wide_and_deep', 'deepfm'])
+def test_ctr_trains(arch):
+    feeds, predict, avg_cost, auc = models.ctr.build(arch)
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.003)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=feeds)
+
+    reader = fluid.batch(
+        fluid.reader.firstn(models.ctr.synthetic_reader(), 512),
+        batch_size=64, drop_last=True)
+    costs = []
+    for epoch in range(3):
+        for batch in reader():
+            c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+            costs.append(float(np.ravel(c)[0]))
+    assert np.mean(costs[-4:]) < np.mean(costs[:4])

@@ -1,0 +1,36 @@
+"""End-to-end: linear regression converges on uci_housing.
+
+Mirrors reference fluid/tests/book/test_fit_a_line.py (train until avg
+cost < threshold).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+def test_fit_a_line_converges():
+    x, y, y_predict, avg_cost = models.fit_a_line.build()
+    sgd = fluid.optimizer.SGDOptimizer(learning_rate=0.01)
+    sgd.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+
+    train_reader = fluid.batch(
+        fluid.reader.shuffle(datasets.uci_housing.train(), buf_size=256),
+        batch_size=32, drop_last=True)
+
+    first = last = None
+    for epoch in range(12):
+        for data in train_reader():
+            out, = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost])
+            if first is None:
+                first = float(out)
+            last = float(out)
+        if last < 12.0:
+            break
+    assert last < first, (first, last)
+    assert last < 12.0, "cost %.3f did not reach threshold" % last

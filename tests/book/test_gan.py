@@ -1,0 +1,31 @@
+"""End-to-end: DCGAN alternating D/G updates in ONE jitted program
+(reference v1_api_demo/gan)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+def test_gan_trains():
+    img, noise, d_loss, g_loss, fake = models.gan.build(img_dim=784)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[img])
+
+    rng = np.random.default_rng(0)
+    reader = fluid.batch(fluid.reader.firstn(datasets.mnist.train(), 256),
+                         batch_size=32, drop_last=True)
+    d_losses, g_losses = [], []
+    for epoch in range(2):
+        for batch in reader():
+            feed = feeder.feed([(s[0],) for s in batch])
+            feed['noise'] = rng.normal(
+                size=(len(batch), models.gan.NOISE_DIM)).astype(np.float32)
+            d, g = exe.run(feed=feed, fetch_list=[d_loss, g_loss])
+            d_losses.append(float(np.ravel(d)[0]))
+            g_losses.append(float(np.ravel(g)[0]))
+    assert all(np.isfinite(d_losses)) and all(np.isfinite(g_losses))
+    # D should learn to separate real/fake better than chance initially
+    assert np.mean(d_losses[-4:]) < np.mean(d_losses[:2])

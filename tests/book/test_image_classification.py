@@ -1,0 +1,49 @@
+"""End-to-end: CIFAR image classification (resnet + vgg tiny configs)
+(reference fluid/tests/book/test_image_classification_train.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+from paddle_tpu.models import resnet, vgg
+
+
+@pytest.mark.parametrize('net', ['resnet', 'vgg'])
+def test_image_classification(net):
+    images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    if net == 'resnet':
+        predict = resnet.resnet_cifar10(images, depth=8)  # tiny for CPU CI
+    else:
+        predict = vgg.vgg16_bn_drop(images)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=predict, label=label)
+
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.002)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[images, label])
+
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.cifar.train10(), 256),
+        batch_size=32, drop_last=True)
+    costs, accs = [], []
+    for epoch in range(3):
+        for batch in reader():
+            c, a = exe.run(feed=feeder.feed(batch),
+                           fetch_list=[avg_cost, acc])
+            costs.append(float(np.ravel(c)[0]))
+            accs.append(float(np.ravel(a)[0]))
+    assert np.all(np.isfinite(costs))
+    if net == 'resnet':
+        assert np.mean(costs[-4:]) < np.mean(costs[:4])
+    else:
+        # VGG16's stacked 0.4/0.5 dropouts make per-batch cost too noisy
+        # for a monotone assertion in ~24 tiny CPU steps; assert training
+        # is stable (no divergence) — convergence is covered by resnet.
+        assert np.mean(costs[-8:]) < costs[0] + 0.5

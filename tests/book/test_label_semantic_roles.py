@@ -1,0 +1,32 @@
+"""End-to-end: SRL BiLSTM-CRF trains on synthetic CoNLL05 (reference
+fluid/tests/book/test_label_semantic_roles.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+def test_label_semantic_roles_trains():
+    word_dict, verb_dict, label_dict = datasets.conll05.get_dict()
+    feeds, feature_out, crf_decode, avg_cost = models.srl.build(
+        len(word_dict), len(verb_dict), 2, len(label_dict))
+
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.01)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=feeds)
+
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.conll05.test(), 128),
+        batch_size=16, drop_last=True)
+    costs = []
+    for epoch in range(2):
+        for batch in reader():
+            c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+            costs.append(float(np.ravel(c)[0]))
+            assert np.isfinite(costs[-1])
+    assert np.mean(costs[-4:]) < np.mean(costs[:4]), \
+        (np.mean(costs[:4]), np.mean(costs[-4:]))

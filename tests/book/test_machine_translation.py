@@ -1,0 +1,31 @@
+"""End-to-end: seq2seq + attention trains on synthetic WMT14 (reference
+fluid/tests/book/test_machine_translation.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+DICT_SIZE = 1000
+
+
+def test_machine_translation_trains():
+    src, trg, label, prediction, avg_cost = models.seq2seq.build(DICT_SIZE)
+
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.002)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[src, trg, label])
+
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.wmt14.train(DICT_SIZE), 256),
+        batch_size=16, drop_last=True)
+    costs = []
+    for epoch in range(3):
+        for batch in reader():
+            c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+            costs.append(float(np.ravel(c)[0]))
+    assert np.mean(costs[-8:]) < np.mean(costs[:8]), \
+        (np.mean(costs[:8]), np.mean(costs[-8:]))

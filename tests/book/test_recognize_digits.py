@@ -1,0 +1,35 @@
+"""End-to-end: MNIST conv + MLP reach accuracy threshold.
+
+Mirrors reference fluid/tests/book/test_recognize_digits_conv.py / _mlp.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+@pytest.mark.parametrize('nn_type', ['mlp', 'conv'])
+def test_recognize_digits(nn_type):
+    img, label, prediction, avg_cost, acc = models.mnist.build(nn_type)
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.003)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+
+    train_reader = fluid.batch(datasets.mnist.train(), batch_size=64,
+                               drop_last=True)
+
+    accs = []
+    for epoch in range(3):
+        for data in train_reader():
+            cost_v, acc_v = exe.run(feed=feeder.feed(data),
+                                    fetch_list=[avg_cost, acc])
+            accs.append(float(acc_v))
+        if np.mean(accs[-10:]) > 0.9:
+            break
+    assert np.mean(accs[-10:]) > 0.9, \
+        "accuracy %.3f below threshold" % np.mean(accs[-10:])

@@ -1,0 +1,36 @@
+"""End-to-end: recommender system cost decreases (reference
+fluid/tests/book/test_recommender_system.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+def test_recommender_system():
+    feed_order, scale_infer, avg_cost = models.recommender.build()
+
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.2)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    block = fluid.default_main_program().global_block()
+    feed_vars = [block.var(n) for n in feed_order]
+    feeder = fluid.DataFeeder(place=place, feed_list=feed_vars)
+
+    def to_feed(batch):
+        # reader slots: uid, gender, age, job, mov_id, cats, title, score
+        return feeder.feed(batch)
+
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.movielens.train(), 512),
+        batch_size=64, drop_last=True)
+    costs = []
+    for epoch in range(4):
+        for batch in reader():
+            c, = exe.run(feed=to_feed(batch), fetch_list=[avg_cost])
+            costs.append(float(np.ravel(c)[0]))
+    assert np.mean(costs[-4:]) < np.mean(costs[:4]), \
+        (np.mean(costs[:4]), np.mean(costs[-4:]))

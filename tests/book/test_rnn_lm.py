@@ -1,0 +1,30 @@
+"""End-to-end: stacked-LSTM LM trains (reference benchmark/paddle/rnn)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+def test_rnn_lm_trains():
+    word_dict = datasets.imikolov.build_dict()
+    vocab = len(word_dict)
+    src, target, avg_cost = models.rnn_lm.build(vocab, emb_dim=32,
+                                                hidden_dim=64, num_layers=2)
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.003)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[src, target])
+
+    seq_reader = datasets.imikolov.train(word_dict, 5,
+                                         datasets.imikolov.DataType.SEQ)
+    reader = fluid.batch(fluid.reader.firstn(seq_reader, 256),
+                         batch_size=16, drop_last=True)
+    costs = []
+    for epoch in range(2):
+        for batch in reader():
+            c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
+            costs.append(float(np.ravel(c)[0]))
+    assert np.mean(costs[-6:]) < np.mean(costs[:6])

@@ -1,0 +1,35 @@
+"""End-to-end: sentiment conv + dynamic LSTM nets train on synthetic IMDB
+(reference fluid/tests/book/test_understand_sentiment_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+@pytest.mark.parametrize('net', ['conv', 'dynamic_lstm'])
+def test_understand_sentiment(net):
+    word_dict = datasets.imdb.word_dict()
+    data, label, avg_cost, acc, prediction = models.sentiment.build(
+        len(word_dict), net)
+
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.002)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[data, label])
+
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.imdb.train(word_dict), 384),
+        batch_size=32, drop_last=True)
+    costs, accs = [], []
+    for epoch in range(3):
+        for batch in reader():
+            c, a = exe.run(feed=feeder.feed(batch),
+                           fetch_list=[avg_cost, acc])
+            costs.append(float(np.ravel(c)[0]))
+            accs.append(float(np.ravel(a)[0]))
+    assert np.mean(costs[-6:]) < np.mean(costs[:6])
+    assert np.mean(accs[-6:]) > 0.6

@@ -1,0 +1,30 @@
+"""End-to-end: word2vec N-gram LM loss decreases (reference
+fluid/tests/book/test_word2vec.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, models
+
+
+def test_word2vec_trains():
+    word_dict = datasets.imikolov.build_dict()
+    dict_size = len(word_dict)
+    words, next_word, predict, avg_cost = models.word2vec.build(dict_size)
+
+    sgd = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+    sgd.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=words + [next_word])
+
+    reader = fluid.batch(datasets.imikolov.train(word_dict, 5),
+                         batch_size=64, drop_last=True)
+    costs = []
+    for epoch in range(2):
+        for data in reader():
+            c, = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost])
+            costs.append(float(np.ravel(c)[0]))
+    assert np.mean(costs[-20:]) < np.mean(costs[:20]), \
+        (np.mean(costs[:20]), np.mean(costs[-20:]))

@@ -1,0 +1,218 @@
+"""Parallel primitives on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8): dp/tp/pp/sp numerics vs single
+-device reference."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import (api, collective, pipeline, ring_attention,
+                                 tensor_parallel)
+
+
+def need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d devices" % n)
+
+
+def test_mesh_and_collectives():
+    need_devices(8)
+    mesh = api.make_mesh((8,), ('x',))
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def f(x):
+        s = collective.allreduce(x, 'x')
+        g = collective.allgather(x, 'x')
+        b = collective.broadcast(x, 'x', root=3)
+        i = collective.axis_index('x').reshape(1)
+        return s, g, b, i
+
+    out = collective.shard_map(
+        f, mesh=mesh, in_specs=P('x', None),
+        out_specs=(P('x', None), P('x', None), P('x', None), P('x')))(x)
+    s, g, b, i = jax.tree.map(np.asarray, out)
+    assert np.allclose(s, 28.0)
+    assert np.allclose(g[:8, 0], np.arange(8))
+    assert np.allclose(b, 3.0)
+    assert list(i) == list(range(8))
+
+
+def test_reduce_scatter_and_all_to_all():
+    need_devices(8)
+    mesh = api.make_mesh((8,), ('x',))
+    x = np.ones((8, 16), dtype=np.float32)
+
+    def f(x):
+        rs = collective.reduce_scatter(x, 'x', axis=1)
+        return rs
+
+    out = collective.shard_map(f, mesh=mesh, in_specs=P('x', None),
+                               out_specs=P('x', None))(x)
+    assert np.asarray(out).shape == (8, 16 // 8 * 8 // 8)  # [8, 2] tiled
+    assert np.allclose(np.asarray(out), 8.0)
+
+
+def test_column_row_parallel_matmul_matches_dense():
+    need_devices(4)
+    mesh = api.make_mesh((4,), ('tp',))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    w1 = rng.normal(size=(16, 32)).astype(np.float32)
+    w2 = rng.normal(size=(32, 16)).astype(np.float32)
+
+    ref = np.maximum(x @ w1, 0) @ w2
+
+    def f(x, w1s, w2s):
+        return tensor_parallel.tp_fc_pair(x, w1s, w2s, 'tp',
+                                          act=jax.nn.relu)
+
+    out = collective.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, None), P(None, 'tp'), P('tp', None)),
+        out_specs=P(None, None))(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_parallel_embedding_matches_dense():
+    need_devices(4)
+    mesh = api.make_mesh((4,), ('tp',))
+    rng = np.random.default_rng(1)
+    table = rng.normal(size=(32, 8)).astype(np.float32)
+    ids = rng.integers(0, 32, size=(6, 5)).astype(np.int32)
+
+    def f(ids, tbl):
+        return tensor_parallel.parallel_embedding(ids, tbl, 'tp')
+
+    out = collective.shard_map(
+        f, mesh=mesh, in_specs=(P(None, None), P('tp', None)),
+        out_specs=P(None, None))(ids, table)
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
+
+
+def test_pipeline_matches_sequential():
+    need_devices(4)
+    S = 4
+    mesh = api.make_mesh((S,), ('pp',))
+    rng = np.random.default_rng(2)
+    # 4 stages, each an affine + tanh with its own params
+    Ws = rng.normal(size=(S, 8, 8)).astype(np.float32) * 0.5
+    bs = rng.normal(size=(S, 8)).astype(np.float32) * 0.1
+    M, mb = 6, 3
+    xs = rng.normal(size=(M, mb, 8)).astype(np.float32)
+
+    # sequential reference
+    ref = xs.copy()
+    for s in range(S):
+        ref = np.tanh(ref @ Ws[s] + bs[s])
+
+    def stage(params, x):
+        W, b = params
+        return jnp.tanh(x @ W + b)
+
+    def f(Ws, bs, xs):
+        return pipeline.pipeline_apply(stage, (Ws[0], bs[0]), xs, 'pp',
+                                       num_stages=S)
+
+    out = collective.shard_map(
+        f, mesh=mesh,
+        in_specs=(P('pp', None, None), P('pp', None), P(None, None, None)),
+        out_specs=P('pp', None, None))(Ws, bs, xs)
+    out = np.asarray(out).reshape(S, M, mb, 8)
+    # only the last stage's recorded outputs are meaningful
+    np.testing.assert_allclose(out[-1], ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_attention_matches_dense(causal):
+    need_devices(4)
+    sp = 4
+    mesh = api.make_mesh((sp,), ('sp',))
+    rng = np.random.default_rng(3)
+    B, T, H, D = 2, 16, 2, 4
+    q = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, H, D)).astype(np.float32)
+
+    # dense reference
+    scale = D ** -0.5
+    s = np.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    if causal:
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum('bhqk,bkhd->bqhd', p, v)
+
+    def f(q, k, v):
+        return ring_attention.ring_attention(q, k, v, 'sp', causal=causal)
+
+    out = collective.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, 'sp', None, None),) * 3,
+        out_specs=P(None, 'sp', None, None))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_seq_heads_roundtrip():
+    need_devices(2)
+    mesh = api.make_mesh((2,), ('sp',))
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 8, 4, 3)).astype(np.float32)
+
+    def f(x):
+        y = ring_attention.seq_to_heads(x, 'sp')
+        return ring_attention.heads_to_seq(y, 'sp')
+
+    out = collective.shard_map(
+        f, mesh=mesh, in_specs=P(None, 'sp', None, None),
+        out_specs=P(None, 'sp', None, None))(x)
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_data_parallel_program_matches_single_device():
+    need_devices(8)
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel.data_parallel import DataParallel
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu',
+                            param_attr='w1', bias_attr='b1')
+        p = fluid.layers.fc(input=h, size=1, param_attr='w2',
+                            bias_attr='b2')
+        cost = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(cost)
+        return cost
+
+    rng = np.random.default_rng(5)
+    xs = rng.normal(size=(16, 8)).astype(np.float32)
+    ys = rng.normal(size=(16, 1)).astype(np.float32)
+
+    results = {}
+    for mode in ('single', 'dp'):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            cost = build()
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        losses = []
+        if mode == 'single':
+            for _ in range(3):
+                out, = exe.run(main, feed={'x': xs, 'y': ys},
+                               fetch_list=[cost], scope=scope)
+                losses.append(float(np.ravel(out)[0]))
+        else:
+            mesh = api.make_mesh((8,), ('dp',))
+            dp = DataParallel(exe, mesh)
+            for _ in range(3):
+                out, = dp.run(main, feed={'x': xs, 'y': ys},
+                              fetch_list=[cost], scope=scope)
+                losses.append(float(np.ravel(out)[0]))
+        results[mode] = losses
+    np.testing.assert_allclose(results['single'], results['dp'],
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,142 @@
+"""Reader decorators + minibatch + synthetic datasets.
+
+Mirrors reference tests python/paddle/v2/reader/tests/decorator_test.py and
+dataset/tests/*."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as rd
+from paddle_tpu import datasets
+
+
+def counter(n):
+    def r():
+        for i in range(n):
+            yield i
+    return r
+
+
+def test_map_readers():
+    out = list(rd.map_readers(lambda a, b: a + b, counter(3), counter(3))())
+    assert out == [0, 2, 4]
+
+
+def test_shuffle_preserves_multiset():
+    out = list(rd.shuffle(counter(100), 17)())
+    assert sorted(out) == list(range(100))
+
+
+def test_chain_compose():
+    assert list(rd.chain(counter(2), counter(3))()) == [0, 1, 0, 1, 2]
+    out = list(rd.compose(counter(3), counter(3))())
+    assert out == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(counter(3), counter(4))())
+
+
+def test_buffered_and_firstn_and_cache():
+    assert list(rd.buffered(counter(10), 2)()) == list(range(10))
+    assert list(rd.firstn(counter(10), 3)()) == [0, 1, 2]
+    c = rd.cache(counter(5))
+    assert list(c()) == list(c()) == list(range(5))
+
+
+def test_xmap_readers():
+    for order in (False, True):
+        out = list(rd.xmap_readers(lambda x: x * 2, counter(32), 4, 8,
+                                   order=order)())
+        if order:
+            assert out == [i * 2 for i in range(32)]
+        else:
+            assert sorted(out) == [i * 2 for i in range(32)]
+
+
+def test_batch():
+    bs = list(rd.batch(counter(10), 4)())
+    assert [len(b) for b in bs] == [4, 4, 2]
+    bs = list(rd.batch(counter(10), 4, drop_last=True)())
+    assert [len(b) for b in bs] == [4, 4]
+
+
+def test_mnist_shapes():
+    img, label = next(datasets.mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label < 10
+
+
+def test_mnist_deterministic():
+    a = [l for _, l in rd.firstn(datasets.mnist.train(), 10)()]
+    b = [l for _, l in rd.firstn(datasets.mnist.train(), 10)()]
+    assert a == b
+
+
+def test_uci_housing():
+    x, y = next(datasets.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_cifar():
+    img, label = next(datasets.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= label < 10
+    img, label = next(datasets.cifar.train100()())
+    assert 0 <= label < 100
+
+
+def test_imdb():
+    w = datasets.imdb.word_dict()
+    ids, label = next(datasets.imdb.train(w)())
+    assert all(0 <= i < len(w) for i in ids)
+    assert label in (0, 1)
+
+
+def test_imikolov():
+    w = datasets.imikolov.build_dict()
+    g = next(datasets.imikolov.train(w, 5)())
+    assert len(g) == 5
+    src, trg = next(datasets.imikolov.train(
+        w, 5, datasets.imikolov.DataType.SEQ)())
+    assert len(src) == len(trg)
+    assert src[0] == w['<s>'] and trg[-1] == w['<e>']
+
+
+def test_movielens():
+    sample = next(datasets.movielens.train()())
+    uid, gender, age, job, mid, cats, title, rating = sample
+    assert 1 <= uid <= datasets.movielens.max_user_id()
+    assert gender in (0, 1)
+    assert 0 <= job <= datasets.movielens.max_job_id()
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert -5.0 <= rating[0] <= 5.0
+
+
+def test_wmt14():
+    src, trg, trg_next = next(datasets.wmt14.train(1000)())
+    assert trg[0] == datasets.wmt14.START_ID
+    assert trg_next[-1] == datasets.wmt14.END_ID
+    assert trg[1:] == trg_next[:-1]
+
+
+def test_conll05():
+    sample = next(datasets.conll05.test()())
+    assert len(sample) == 9
+    L = len(sample[0])
+    assert all(len(s) == L for s in sample)
+    word_d, verb_d, label_d = datasets.conll05.get_dict()
+    assert 'B-V' in label_d
+
+
+def test_mq2007():
+    hi, lo = next(datasets.mq2007.train('pairwise')())
+    assert hi.shape == (46,) and lo.shape == (46,)
+
+
+def test_recordio_roundtrip(tmp_path):
+    from paddle_tpu.io_recordio import RecordReader, RecordWriter
+    p = str(tmp_path / "f.rec")
+    with RecordWriter(p) as w:
+        for i in range(10):
+            w.write(b'payload-%d' % i)
+    got = [r for r in RecordReader(p)]
+    assert got == [b'payload-%d' % i for i in range(10)]
