@@ -97,8 +97,12 @@ def _run_one(op, env, ctx, op_index, frozen=()):
                     "startup program, or check op ordering" % (op.type, n))
             vals.append(env[n])
         ins[slot] = vals
+    if impl.needs_env:
+        ins['__env__'] = [env]
     ctx.op_index = op_index
     outs = impl.compute(ctx, ins, op.attrs) or {}
+    if '__env_update__' in outs:
+        env.update(outs.pop('__env_update__')[0])
     for slot, names in op.outputs.items():
         vals = outs.get(slot, [])
         for n, v in zip(names, vals):

@@ -14,18 +14,21 @@ _OP_REGISTRY = {}
 
 
 class OpImpl(object):
-    def __init__(self, type, compute, stateful_rng=False):
+    def __init__(self, type, compute, stateful_rng=False, needs_env=False):
         self.type = type
         self.compute = compute
         # ops that consume PRNG (dropout, *_random) — executor threads keys
         self.stateful_rng = stateful_rng
+        # control-flow ops that interpret sub-blocks get the live env dict
+        # as ins['__env__'] and may return {'__env_update__': [dict]}
+        self.needs_env = needs_env
 
 
-def register_op(type, stateful_rng=False):
+def register_op(type, stateful_rng=False, needs_env=False):
     def deco(fn):
         if type in _OP_REGISTRY:
             raise ValueError("op %r already registered" % type)
-        _OP_REGISTRY[type] = OpImpl(type, fn, stateful_rng)
+        _OP_REGISTRY[type] = OpImpl(type, fn, stateful_rng, needs_env)
         return fn
 
     return deco

@@ -1,16 +1,21 @@
 """Layer library (parity with python/paddle/v2/fluid/layers)."""
 from .. import ops as _ops  # ensure op registry is populated  # noqa: F401
 
-from . import io, nn, ops, sequence, tensor
+from . import control_flow, io, nn, ops, sequence, tensor
+from .control_flow import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
+from .beam_search import *  # noqa: F401,F403
+from . import beam_search as _bs
 
 __all__ = []
+__all__ += control_flow.__all__
 __all__ += io.__all__
 __all__ += nn.__all__
 __all__ += ops.__all__
 __all__ += sequence.__all__
 __all__ += tensor.__all__
+__all__ += _bs.__all__

@@ -1,0 +1,512 @@
+"""Control-flow layers.
+
+Reference parity: python/paddle/v2/fluid/layers/control_flow.py (While,
+StaticRNN, DynamicRNN, IfElse, array ops, lod_rank_table ...).
+
+TPU-native semantics (see ops/control_flow.py): While lowers to a bounded
+masked `lax.scan` (needs a max_iters bound — explicit or inferred from a
+``less_than(counter, fill_constant)`` condition); StaticRNN/DynamicRNN
+lower to one `lax.scan` over time; IfElse computes both branches on the
+full batch and merges rows by the condition mask (mathematically the
+reference's split/merge, without the gather/scatter).
+"""
+import contextlib
+
+from ..core.program import LEN_SUFFIX, Variable
+from .layer_helper import LayerHelper
+from .tensor import fill_constant
+
+__all__ = [
+    'While', 'StaticRNN', 'DynamicRNN', 'IfElse', 'lod_rank_table',
+    'max_sequence_len', 'lod_tensor_to_array', 'array_to_lod_tensor',
+    'increment', 'array_write', 'create_array', 'array_read',
+    'array_length', 'shrink_memory', 'less_than', 'equal', 'Print',
+    'ParallelDo', 'split_lod_tensor', 'merge_lod_tensor',
+]
+
+from .tensor import less_than, equal  # re-export (fluid puts them here)
+
+
+def increment(x, value=1.0, in_place=True, **kwargs):
+    helper = LayerHelper('increment', **kwargs)
+    out = x if in_place else helper.create_tmp_variable(x.dtype)
+    helper.append_op(type='increment', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'step': float(value)},
+                     infer_shape=False)
+    return out
+
+
+def create_array(dtype='float32', **kwargs):
+    helper = LayerHelper('create_array', **kwargs)
+    arr = helper.create_variable(
+        name=helper.name + '.out', dtype=dtype, shape=(), lod_level=0)
+    helper.append_op(type='create_array', inputs={},
+                     outputs={'Out': [arr]},
+                     attrs={'elem_dtype': dtype}, infer_shape=False)
+    return arr
+
+
+def array_write(x, i, array=None, **kwargs):
+    helper = LayerHelper('array_write', **kwargs)
+    if array is None:
+        array = create_array(dtype=x.dtype)
+    helper.append_op(
+        type='write_to_array',
+        inputs={'Array': [array], 'V': [x], 'I': [i]},
+        outputs={'Out': [array]}, infer_shape=False)
+    return array
+
+
+def array_read(array, i, **kwargs):
+    helper = LayerHelper('array_read', **kwargs)
+    out = helper.create_tmp_variable('float32')
+    helper.append_op(
+        type='read_from_array', inputs={'Array': [array], 'I': [i]},
+        outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def array_length(array, **kwargs):
+    helper = LayerHelper('array_length', **kwargs)
+    out = helper.create_tmp_variable('int32')
+    helper.append_op(type='array_length', inputs={'X': [array]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def lod_rank_table(x, level=0, **kwargs):
+    """Returns the lengths vector (the TPU stand-in for the rank table —
+    no sequence reordering happens; masks replace batch shrinking)."""
+    helper = LayerHelper('lod_rank_table', **kwargs)
+    out = helper.create_tmp_variable('int32')
+    inputs = {'X': [x]}
+    block = helper.main_program.current_block()
+    if block.has_var_recursive(x.name + LEN_SUFFIX):
+        inputs['XLen'] = [block.var_recursive(x.name + LEN_SUFFIX)]
+    helper.append_op(type='lod_rank_table', inputs=inputs,
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def max_sequence_len(rank_table, **kwargs):
+    helper = LayerHelper('max_seqence_len', **kwargs)
+    out = helper.create_tmp_variable('int32')
+    helper.append_op(type='max_sequence_len',
+                     inputs={'RankTable': [rank_table]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def lod_tensor_to_array(x, table=None, **kwargs):
+    helper = LayerHelper('lod_tensor_to_array', **kwargs)
+    arr = helper.create_variable(name=helper.name + '.out', dtype=x.dtype,
+                                 shape=(), lod_level=0)
+    helper.append_op(type='lod_tensor_to_array', inputs={'X': [x]},
+                     outputs={'Out': [arr]}, infer_shape=False)
+    return arr
+
+
+def array_to_lod_tensor(x, table=None, **kwargs):
+    helper = LayerHelper('array_to_lod_tensor', **kwargs)
+    out = helper.create_tmp_variable('float32', lod_level=1)
+    helper.append_op(type='array_to_lod_tensor', inputs={'X': [x]},
+                     outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def shrink_memory(x, i, table, **kwargs):
+    helper = LayerHelper('shrink_memory', **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type='shrink_rnn_memory',
+        inputs={'X': [x], 'I': [i], 'RankTable': [table]},
+        outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def split_lod_tensor(input, mask, level=0, **kwargs):
+    """Fluid splits rows by mask into two tensors.  Dense equivalent:
+    both "halves" keep full shape; rows not in the half are zeroed.  Used
+    by IfElse; the merge is mask-select, so the round trip is exact."""
+    helper = LayerHelper('split_lod_tensor', **kwargs)
+    out_true = helper.create_tmp_variable(input.dtype)
+    out_false = helper.create_tmp_variable(input.dtype)
+    helper.append_op(
+        type='split_lod_tensor',
+        inputs={'X': [input], 'Mask': [mask]},
+        outputs={'OutTrue': [out_true], 'OutFalse': [out_false]},
+        infer_shape=False)
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0, **kwargs):
+    helper = LayerHelper('merge_lod_tensor', **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type='merge_lod_tensor',
+        inputs={'X': [x], 'Mask': [mask], 'InTrue': [in_true],
+                'InFalse': [in_false]},
+        outputs={'Out': [out]}, infer_shape=False)
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both', **kwargs):
+    """Parity with fluid.layers.Print → jax.debug.print inside the jitted
+    program."""
+    helper = LayerHelper('print', **kwargs)
+    helper.append_op(
+        type='print', inputs={'In': [input]}, outputs={'Out': [input]},
+        attrs={'message': message or '', 'first_n': first_n,
+               'summarize': summarize}, infer_shape=False)
+    return input
+
+
+class BlockGuard(object):
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program.rollback()
+        return exc_type is None
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op):
+        super(WhileGuard, self).__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        self.while_op.status = While.IN_WHILE_BLOCK
+        return super(WhileGuard, self).__enter__()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.while_op.status = While.AFTER_WHILE_BLOCK
+        self.while_op.complete()
+        return super(WhileGuard, self).__exit__(exc_type, exc_val, exc_tb)
+
+
+class While(object):
+    """fluid.layers.While parity.  `max_iters` bounds the masked scan; if
+    omitted, it is inferred from a `less_than(counter, fill_constant)`
+    condition."""
+
+    BEFORE_WHILE_BLOCK = 0
+    IN_WHILE_BLOCK = 1
+    AFTER_WHILE_BLOCK = 2
+
+    def __init__(self, cond, max_iters=None, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.status = While.BEFORE_WHILE_BLOCK
+        if not isinstance(cond, Variable):
+            raise TypeError("condition should be a variable")
+        self.cond_var = cond
+        self.max_iters = max_iters
+
+    def block(self):
+        return WhileGuard(self)
+
+    def _infer_max_iters(self):
+        """Find `less_than(X=counter, Y=limit)` producing the condition,
+        with `limit` from a fill_constant — the loop bound."""
+        block = self.helper.main_program.blocks[0]
+        limit_name = None
+        for op in block.ops:
+            if op.type == 'less_than' and \
+                    self.cond_var.name in op.output_arg_names:
+                limit_name = op.inputs.get('Y', [None])[0]
+        if limit_name is None:
+            return None
+        for op in block.ops:
+            if op.type == 'fill_constant' and \
+                    limit_name in op.output_arg_names:
+                return int(op.attrs['value'])
+        return None
+
+    def complete(self):
+        main_program = self.helper.main_program
+        while_block = main_program.current_block()
+        max_iters = self.max_iters
+        if max_iters is None:
+            max_iters = self._infer_max_iters()
+        self.helper.append_op(
+            type='while',
+            inputs={'Condition': [self.cond_var]},
+            outputs={},
+            attrs={'sub_block': while_block.idx,
+                   'condition': self.cond_var.name,
+                   'max_iters': max_iters},
+            infer_shape=False)
+
+
+class StaticRNN(object):
+    """fluid.layers.StaticRNN parity: a per-timestep block lowered to one
+    `lax.scan`.  Differences from the reference API surface: none for the
+    book usage (step_input/memory/update_memory/step_output/output)."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.memories = {}  # inner mem var name -> (boot var, updated name)
+        self.step_inputs = []  # (outer var, inner var)
+        self.step_outputs = []  # inner vars
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_len = None
+        self._block_idx = None
+        self._lengths_var = None
+
+    @contextlib.contextmanager
+    def step(self):
+        self.status = StaticRNN.IN_RNN_BLOCK
+        prog = self.helper.main_program
+        prog.create_block()
+        self._block_idx = prog.current_block().idx
+        yield
+        self.status = StaticRNN.AFTER_RNN_BLOCK
+        prog.rollback()
+        self._complete_op()
+
+    def _assert_in_rnn_block_(self, method):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError("You must invoke {0} in rnn block".format(
+                method))
+
+    def step_input(self, x):
+        """x: [B, T, ...] outer var -> per-step [B, ...] inner var."""
+        self._assert_in_rnn_block_('step_input')
+        block = self.helper.main_program.current_block()
+        inner = block.create_var(
+            name=x.name + '@step', dtype=x.dtype,
+            shape=(x.shape[0],) + tuple(x.shape[2:]), lod_level=0)
+        self.step_inputs.append((x, inner))
+        if self.seq_len is None:
+            self.seq_len = x.shape[1]
+        outer_block = self.helper.main_program.blocks[0]
+        if x.lod_level > 0 and \
+                outer_block.has_var_recursive(x.name + LEN_SUFFIX):
+            self._lengths_var = outer_block.var_recursive(
+                x.name + LEN_SUFFIX)
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1,
+               dtype='float32'):
+        self._assert_in_rnn_block_('memory')
+        if init is None:
+            if shape is None and batch_ref is None:
+                raise ValueError("memory needs init or shape/batch_ref")
+            helper = self.helper
+            # boot memory [batch, *shape] built with
+            # fill_constant_batch_size_like in the OUTER block
+            from .tensor import fill_constant_batch_size_like
+            prog = helper.main_program
+            cur = prog.current_block_idx
+            prog.current_block_idx = 0
+            ref = batch_ref if batch_ref is not None else \
+                self.step_inputs[0][0]
+            init = fill_constant_batch_size_like(
+                input=ref, shape=[-1] + list(shape[1:] if shape else []),
+                value=init_value, dtype=dtype,
+                input_dim_idx=init_batch_dim_idx)
+            prog.current_block_idx = cur
+        block = self.helper.main_program.current_block()
+        mem = block.create_var(
+            name=init.name + '@mem', dtype=init.dtype,
+            shape=init.shape, lod_level=0)
+        self.memories[mem.name] = [init, None, mem]
+        return mem
+
+    def update_memory(self, mem, x):
+        self._assert_in_rnn_block_('update_memory')
+        self.memories[mem.name][1] = x.name
+
+    def step_output(self, o):
+        self._assert_in_rnn_block_('step_output')
+        self.step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete_op(self):
+        helper = self.helper
+        block = helper.main_program.blocks[0]
+        inputs = {'__ignore__': []}
+        memories_attr = []
+        for mem_name, (boot, upd_name, mem) in self.memories.items():
+            if upd_name is None:
+                raise ValueError("memory %s never updated" % mem_name)
+            inputs['Boot_' + mem_name] = [boot]
+            memories_attr.append((mem_name, upd_name))
+        if self._lengths_var is not None:
+            inputs['XLen'] = [self._lengths_var]
+        self._outer_outputs = []
+        outputs = {}
+        for o in self.step_outputs:
+            outer = block.create_var(
+                name=o.name + '@stacked', dtype=o.dtype,
+                shape=(o.shape[0], self.seq_len) + tuple(o.shape[1:]),
+                lod_level=1 if self._lengths_var is not None else 0)
+            outputs['Out_' + o.name] = [outer]
+            self._outer_outputs.append(outer)
+            if self._lengths_var is not None:
+                ln = block.create_var(
+                    name=outer.name + LEN_SUFFIX, shape=[-1],
+                    dtype='int32')
+                ln.stop_gradient = True
+                helper.append_op(
+                    type='assign', inputs={'X': [self._lengths_var]},
+                    outputs={'Out': [ln]}, infer_shape=False)
+        helper.append_op(
+            type='recurrent',
+            inputs=inputs,
+            outputs=outputs,
+            attrs={'sub_block': self._block_idx,
+                   'step_inputs': [(o.name, i.name)
+                                   for o, i in self.step_inputs],
+                   'memories': memories_attr,
+                   'step_outputs': [o.name for o in self.step_outputs],
+                   'seq_len': self.seq_len},
+            infer_shape=False)
+
+    def __call__(self, *args, **kwargs):
+        outs = self._outer_outputs
+        return outs[0] if len(outs) == 1 else outs
+
+
+class DynamicRNN(object):
+    """fluid.layers.DynamicRNN parity over padded+lengths sequences: the
+    same lax.scan as StaticRNN with per-row masking (padded steps carry
+    memory through and emit zeros).  The reference sorts sequences via a
+    rank table and shrinks the batch per step; masking is the dense
+    equivalent with identical results."""
+
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name=name)
+        self.status = DynamicRNN.BEFORE_RNN
+
+    @contextlib.contextmanager
+    def block(self):
+        self.status = DynamicRNN.IN_RNN
+        with self._rnn.step():
+            yield
+        self.status = DynamicRNN.AFTER_RNN
+
+    def step_input(self, x):
+        return self._rnn.step_input(x)
+
+    def static_input(self, x):
+        return x  # dense batch: static inputs are just closed over
+
+    def memory(self, init=None, shape=None, value=0.0, dtype='float32',
+               **kw):
+        return self._rnn.memory(init=init, shape=[-1] + list(shape or []),
+                                init_value=value, dtype=dtype)
+
+    def update_memory(self, ex_mem, new_mem):
+        self._rnn.update_memory(ex_mem, new_mem)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def __call__(self, *args, **kwargs):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError(
+                "Output of the dynamic RNN can only be visited "
+                "outside the rnn block.")
+        return self._rnn()
+
+
+class IfElse(object):
+    """fluid.layers.IfElse parity.  Dense semantics: both branches run on
+    the FULL batch; `input(x)` hands the branch the full tensor, and the
+    final outputs merge rows by the boolean condition — exactly fluid's
+    split_lod_tensor/merge_lod_tensor composition, without gathers."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper('ifelse', name=name)
+        self.cond = cond
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.output_table = [[], []]  # false, true
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self.status = IfElse.IN_IF_ELSE_TRUE_BLOCKS
+        yield
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self.status = IfElse.IN_IF_ELSE_FALSE_BLOCKS
+        yield
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside a branch block")
+        return x
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output() must be called inside a branch")
+        table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        table.extend(outs)
+
+    def __call__(self):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("IfElse::__call__ must be out of sub-block")
+        false_outs, true_outs = self.output_table
+        if len(false_outs) != len(true_outs):
+            raise ValueError("true and false blocks must produce the same "
+                             "number of outputs")
+        rets = []
+        from .tensor import select
+        for t, f in zip(true_outs, false_outs):
+            rets.append(select(self.cond, t, f))
+        return rets[0] if len(rets) == 1 else rets
+
+
+class ParallelDo(object):
+    """fluid.layers.ParallelDo parity shell.  The reference splits the
+    batch across GPU places and runs the sub-block per device; the TPU
+    -native equivalent is mesh data parallelism (parallel/data_parallel
+    .py), so this guard simply builds the block inline — running it under
+    DataParallel shards it for real."""
+
+    def __init__(self, places, name=None):
+        self.helper = LayerHelper('parallel_do', name=name)
+        self._outputs = []
+
+    @contextlib.contextmanager
+    def do(self):
+        yield
+
+    def read_input(self, x):
+        return x
+
+    def write_output(self, o):
+        self._outputs.append(o)
+
+    def __call__(self):
+        outs = self._outputs
+        return outs[0] if len(outs) == 1 else outs

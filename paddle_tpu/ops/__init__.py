@@ -3,6 +3,7 @@
 Reference parity: paddle/operators/* (one jax function per reference op
 kernel family; see SURVEY.md §2.2).
 """
-from . import (activations, common, conv, crf, ctc, embedding, loss, math,
-               metrics, norm, optim_ops, pool, random, rnn, sequence,
+from . import (activations, beam_search, common, control_flow, conv, crf,
+               ctc, embedding, loss, math, metrics, misc, norm, optim_ops,
+               pool, random, rnn, sequence, tensor_array,
                tensor_ops)  # noqa: F401

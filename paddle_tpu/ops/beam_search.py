@@ -1,0 +1,102 @@
+"""Beam search ops (O14).
+
+Reference parity: operators/beam_search_op.cc + beam_search_decode_op.cc.
+The reference prunes LoD-nested candidate lists on the host per step; the
+TPU design is dense and static-shape: beams live in a fixed [B, K] lattice,
+one `lax.top_k` over K*V flattened continuations per step, finished beams
+(emitted end_id) freeze their score and only propose end_id, and the
+decode op backtracks the [T, B, K] parent lattice with a reverse scan —
+the whole search jits into the same program as the model.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import first
+
+__all__ = ['beam_search_step', 'beam_search_backtrack']
+
+NEG_INF = -1e9
+
+
+def beam_search_step(pre_ids, pre_scores, scores, beam_size, end_id):
+    """One pruning step.
+
+    pre_ids, pre_scores: [B, K]; scores: [B, K, V] log-probs of the next
+    token.  Returns (ids [B,K], accumulated scores [B,K], parents [B,K]).
+    """
+    B, K, V = scores.shape
+    finished = (pre_ids == end_id)  # [B, K]
+    total = pre_scores[:, :, None] + scores.astype(jnp.float32)
+    # finished beams: only candidate is end_id, score frozen
+    fin = jnp.full_like(total, NEG_INF)
+    fin = fin.at[:, :, end_id].set(pre_scores)
+    total = jnp.where(finished[:, :, None], fin, total)
+    flat = total.reshape(B, K * V)
+    top_scores, top_idx = lax.top_k(flat, beam_size)  # [B, K]
+    parents = top_idx // V
+    ids = top_idx % V
+    return ids.astype(jnp.int32), top_scores, parents.astype(jnp.int32)
+
+
+@register_op('beam_search')
+def _beam_search(ctx, ins, attrs):
+    pre_ids = first(ins, 'pre_ids')
+    pre_scores = first(ins, 'pre_scores')
+    scores = first(ins, 'scores')
+    beam_size = int(attrs['beam_size'])
+    end_id = int(attrs['end_id'])
+    if pre_ids.ndim == 3:
+        pre_ids = pre_ids[..., 0]
+    if pre_scores.ndim == 3:
+        pre_scores = pre_scores[..., 0]
+    ids, sc, parents = beam_search_step(pre_ids, pre_scores, scores,
+                                        beam_size, end_id)
+    return {'selected_ids': [ids], 'selected_scores': [sc],
+            'parent_idx': [parents]}
+
+
+def beam_search_backtrack(ids_tbk, parents_tbk, steps, end_id):
+    """ids/parents: [T, B, K] lattices; steps: valid step count (traced).
+    Returns sequences [B, K, T] (end_id-padded) ordered best-first."""
+    T, B, K = ids_tbk.shape
+    t_idx = jnp.arange(T)
+    valid = t_idx < steps  # [T]
+
+    def back(beam_ptr, inp):
+        ids_t, parents_t, is_valid = inp
+        tok = jnp.take_along_axis(ids_t, beam_ptr, axis=1)  # [B, K]
+        par = jnp.take_along_axis(parents_t, beam_ptr, axis=1)
+        tok = jnp.where(is_valid, tok, end_id)
+        new_ptr = jnp.where(is_valid, par, beam_ptr)
+        return new_ptr, tok
+
+    init_ptr = jnp.tile(jnp.arange(K, dtype=jnp.int32)[None, :], (B, 1))
+    _, toks = lax.scan(back, init_ptr,
+                       (ids_tbk, parents_tbk, valid), reverse=True)
+    return jnp.moveaxis(toks, 0, 2)  # [B, K, T] in forward order
+
+
+@register_op('beam_search_decode')
+def _beam_search_decode(ctx, ins, attrs):
+    ids_arr = first(ins, 'Ids')  # TArray [T, B, K] (or raw array)
+    parents_arr = first(ins, 'Parents')
+    scores_arr = first(ins, 'Scores')
+    end_id = int(attrs['end_id'])
+    from .tensor_array import TArray
+    if isinstance(ids_arr, TArray):
+        steps = ids_arr.size
+        ids_tbk, parents_tbk = ids_arr.data, parents_arr.data
+    else:
+        ids_tbk, parents_tbk = ids_arr, parents_arr
+        steps = jnp.asarray(ids_tbk.shape[0], jnp.int32)
+    seqs = beam_search_backtrack(ids_tbk, parents_tbk, steps, end_id)
+    if isinstance(scores_arr, TArray):
+        T = scores_arr.capacity
+        last = jnp.maximum(scores_arr.size - 1, 0)
+        final_scores = jax.lax.dynamic_index_in_dim(
+            scores_arr.data, last, 0, keepdims=False)  # [B, K]
+    else:
+        final_scores = scores_arr[-1]
+    return {'SentenceIds': [seqs], 'SentenceScores': [final_scores]}

@@ -1,0 +1,184 @@
+"""Control-flow ops (O13): while / conditional_block / recurrent.
+
+Reference parity: paddle/operators/while_op.cc, conditional_block_op.cc,
+recurrent_op.cc.  The reference interprets sub-blocks per iteration on the
+host; here a sub-block is traced ONCE and lowered to `lax.scan`:
+
+- `while`: a bounded masked scan — runs `max_iters` ticks, each tick
+  applies the sub-block and keeps the old carry where the loop condition
+  has gone false.  Static shapes, reverse-mode differentiable (unlike
+  lax.while_loop), and the mask converges to a no-op XLA select on the
+  padded tail.  `max_iters` comes from the While layer (explicit argument
+  or inferred from a `less_than(counter, fill_constant)` condition).
+- `conditional_block`: both paths are computed and the written vars are
+  selected by the scalar condition (the TPU answer to divergent control
+  flow; fluid's scope-isolation semantics are preserved by the select).
+- `recurrent` (StaticRNN/DynamicRNN): one lax.scan over time with
+  memories as carry; per-sequence lengths mask memory updates so padded
+  steps carry state through unchanged.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+from .tensor_array import EmptyTArray, TArray
+
+__all__ = []
+
+
+def _block_rw(program, block_idx):
+    """(read, written) var-name sets of a block, nested blocks included."""
+    block = program.blocks[block_idx]
+    read, written = set(), set()
+    for op in block.ops:
+        read.update(op.input_arg_names)
+        written.update(op.output_arg_names)
+        for attr in ('sub_block', 'block'):
+            if attr in op.attrs:
+                r2, w2 = _block_rw(program, int(op.attrs[attr]))
+                read |= r2
+                written |= w2
+    return read, written
+
+
+def _scalar_bool(x):
+    return jnp.asarray(x).reshape(()).astype(bool)
+
+
+def _select(pred, new, old):
+    def sel(a, b):
+        return jnp.where(pred, a, b)
+    return jax.tree_util.tree_map(sel, new, old)
+
+
+@register_op('while', needs_env=True)
+def _while(ctx, ins, attrs):
+    sub_idx = int(attrs['sub_block'])
+    cond_name = attrs['condition']
+    max_iters = attrs.get('max_iters')
+    if max_iters is None:
+        raise ValueError(
+            "while op needs max_iters (pass max_iters= to layers.While, or "
+            "use a less_than(counter, fill_constant) condition so the bound "
+            "is inferable)")
+    max_iters = int(max_iters)
+
+    program = ctx.program
+    read, written = _block_rw(program, sub_idx)
+    env = ins['__env__'][0]  # executor hands the live env dict
+    carry_names = sorted(n for n in written if n in env)
+    if cond_name not in carry_names and cond_name in env:
+        carry_names.append(cond_name)
+
+    carry0 = {n: env[n] for n in carry_names}
+    if any(isinstance(v, EmptyTArray) for v in carry0.values()):
+        # arrays first written INSIDE the loop: learn their allocated
+        # shape with one speculative trace of the body, then start the
+        # scan from zeroed buffers (structure must be loop-invariant)
+        env_probe = dict(env)
+        ctx.run_block(sub_idx, env_probe)
+        for n, v in list(carry0.items()):
+            if isinstance(v, EmptyTArray):
+                probed = env_probe.get(n)
+                if not isinstance(probed, TArray):
+                    raise ValueError(
+                        "tensor array %r is read in a while loop before "
+                        "any write; write once before the loop or pass "
+                        "elem_shape to create_array" % n)
+                carry0[n] = TArray(jnp.zeros_like(probed.data),
+                                   jnp.asarray(0, jnp.int32))
+
+    def body(carry, _):
+        active = _scalar_bool(carry[cond_name])
+        env2 = dict(env)
+        env2.update(carry)
+        ctx.run_block(sub_idx, env2)
+        new_carry = {n: env2[n] for n in carry_names}
+        new_carry = _select(active, new_carry, carry)
+        return new_carry, None
+
+    final, _ = jax.lax.scan(body, carry0, None, length=max_iters)
+    return {'__env_update__': [final]}
+
+
+@register_op('conditional_block', needs_env=True)
+def _conditional_block(ctx, ins, attrs):
+    sub_idx = int(attrs['sub_block'])
+    cond = _scalar_bool(first(ins, 'Cond'))
+    env = ins['__env__'][0]
+    program = ctx.program
+    read, written = _block_rw(program, sub_idx)
+
+    env2 = dict(env)
+    ctx.run_block(sub_idx, env2)
+    update = {}
+    for n in written:
+        if n in env2:
+            if n in env:
+                update[n] = _select(cond, env2[n], env[n])
+            else:
+                # var born inside the block: zero when cond is false
+                update[n] = _select(cond, env2[n],
+                                    jax.tree_util.tree_map(
+                                        jnp.zeros_like, env2[n]))
+    return {'__env_update__': [update]}
+
+
+@register_op('recurrent', needs_env=True)
+def _recurrent(ctx, ins, attrs):
+    """StaticRNN/DynamicRNN: lax.scan over the time axis.
+
+    attrs: sub_block, step_inputs [(outer_name, inner_name)],
+    memories [(inner_mem_name, inner_updated_name)], boot ins 'Boot:<mem>',
+    step_outputs [inner_name], lengths var optional ('XLen' slot).
+    """
+    sub_idx = int(attrs['sub_block'])
+    step_inputs = [tuple(p) for p in attrs['step_inputs']]
+    memories = [tuple(p) for p in attrs['memories']]
+    step_outputs = list(attrs['step_outputs'])
+    env = ins['__env__'][0]
+
+    xs = {inner: jnp.moveaxis(env[outer], 1, 0)
+          for outer, inner in step_inputs}  # [T, B, ...]
+    T = next(iter(xs.values())).shape[0] if xs else int(attrs['seq_len'])
+
+    boots = {mem: ins['Boot_' + mem][0] for mem, _ in memories}
+    lengths = first(ins, 'XLen')
+
+    def body(carry, inp):
+        t, mems = carry
+        env2 = dict(env)
+        env2.update({inner: inp[inner] for _, inner in
+                     [(o, i) for o, i in step_inputs]})
+        env2.update(mems)
+        ctx.run_block(sub_idx, env2)
+        new_mems = {}
+        for mem, upd in memories:
+            new = env2[upd]
+            if lengths is not None:
+                active = (t < lengths.astype(jnp.int32))
+                shape = (new.shape[0],) + (1,) * (new.ndim - 1)
+                new = jnp.where(active.reshape(shape), new, mems[mem])
+            new_mems[mem] = new
+        outs_t = []
+        for n in step_outputs:
+            o = env2[n]
+            if lengths is not None:
+                active = (t < lengths.astype(jnp.int32))
+                shape = (o.shape[0],) + (1,) * (o.ndim - 1)
+                o = jnp.where(active.reshape(shape), o, jnp.zeros_like(o))
+            outs_t.append(o)
+        return (t + 1, new_mems), tuple(outs_t)
+
+    init = (jnp.asarray(0, jnp.int32), boots)
+    xs_stacked = {inner: xs[inner] for _, inner in step_inputs}
+    (_, final_mems), outs = jax.lax.scan(
+        body, init, xs_stacked if xs_stacked else None,
+        length=None if xs_stacked else T)
+
+    result = {'Out_' + n: [jnp.moveaxis(o, 0, 1)]
+              for n, o in zip(step_outputs, outs)}  # [B, T, ...]
+    for mem, _ in memories:
+        result['FinalMem_' + mem] = [final_mems[mem]]
+    return result

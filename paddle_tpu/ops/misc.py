@@ -1,0 +1,57 @@
+"""Misc ops (O19): print, is_empty, split/merge_lod_tensor, get_places.
+
+Reference parity: operators/print_op.cc, is_empty_op.cc,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+__all__ = []
+
+
+@register_op('print')
+def _print(ctx, ins, attrs):
+    x = first(ins, 'In')
+    msg = attrs.get('message') or ''
+    jax.debug.print(msg + "{x}", x=x)
+    return out(x)
+
+
+@register_op('is_empty')
+def _is_empty(ctx, ins, attrs):
+    x = first(ins, 'X')
+    return out(jnp.asarray([x.size == 0]))
+
+
+def _row_mask(mask, x):
+    m = jnp.asarray(mask).reshape(-1).astype(bool)
+    return m.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+
+
+@register_op('split_lod_tensor')
+def _split_lod_tensor(ctx, ins, attrs):
+    """Dense split: both outputs keep the full batch; rows outside the
+    half are zeroed.  merge selects per row, so split∘merge == identity —
+    the fluid split/merge pair without gather/scatter (static shapes)."""
+    x = first(ins, 'X')
+    m = _row_mask(first(ins, 'Mask'), x)
+    return {'OutTrue': [jnp.where(m, x, 0)],
+            'OutFalse': [jnp.where(m, 0, x)]}
+
+
+@register_op('merge_lod_tensor')
+def _merge_lod_tensor(ctx, ins, attrs):
+    x = first(ins, 'X')
+    in_true = first(ins, 'InTrue')
+    in_false = first(ins, 'InFalse')
+    m = _row_mask(first(ins, 'Mask'), in_true)
+    return out(jnp.where(m, in_true, in_false))
+
+
+@register_op('get_places')
+def _get_places(ctx, ins, attrs):
+    n = int(attrs.get('device_count', 1))
+    return out(jnp.arange(n, dtype=jnp.int32))

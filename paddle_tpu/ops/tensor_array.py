@@ -1,0 +1,166 @@
+"""LoDTensorArray ops (O17).
+
+Reference parity: paddle/operators/tensor_array_read_write_op.cc,
+lod_tensor_to_array / array_to_lod_tensor, lod_rank_table,
+max_sequence_len, shrink_rnn_memory.
+
+TPU-native design: an array is a `TArray` pytree — a preallocated stacked
+buffer [N, ...] plus a traced int32 `size` — so reads/writes are
+`dynamic_(update_)slice` on static shapes and an array can ride a
+`lax.scan`/`while` carry.  Writes past the preallocated capacity are a
+trace-time error (capacity comes from the time axis or the While layer's
+max_iters), not a silent reallocation: growth is a host concept TPUs
+don't have.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+
+__all__ = ['TArray']
+
+
+class TArray(object):
+    """Stacked tensor array: data [N, ...], size (traced int32)."""
+
+    def __init__(self, data, size):
+        self.data = data
+        self.size = size
+
+    def tree_flatten(self):
+        return (self.data, self.size), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self):
+        return self.data.shape[0]
+
+
+jax.tree_util.register_pytree_node(
+    TArray, lambda a: a.tree_flatten(),
+    lambda aux, ch: TArray.tree_unflatten(aux, ch))
+
+
+class EmptyTArray(object):
+    """A created-but-never-written array: carries only its dtype.  The
+    first write_to_array allocates the real buffer (capacity attr or
+    DEFAULT_CAPACITY)."""
+
+    def __init__(self, dtype='float32'):
+        self.dtype = dtype
+
+
+jax.tree_util.register_pytree_node(
+    EmptyTArray, lambda a: ((), a.dtype),
+    lambda dtype, ch: EmptyTArray(dtype))
+
+DEFAULT_CAPACITY = 128
+
+
+def _as_index(i):
+    i = jnp.asarray(i)
+    return i.reshape(()).astype(jnp.int32)
+
+
+@register_op('create_array')
+def _create_array(ctx, ins, attrs):
+    """Create an array.  With `capacity` + `elem_shape` attrs the buffer
+    is allocated now; otherwise the first write_to_array allocates it."""
+    dtype = attrs.get('elem_dtype', 'float32')
+    if 'capacity' in attrs and 'elem_shape' in attrs:
+        cap = int(attrs['capacity'])
+        shape = tuple(int(d) for d in attrs['elem_shape'])
+        data = jnp.zeros((cap,) + shape, dtype=dtype)
+        return out(TArray(data, jnp.asarray(0, jnp.int32)))
+    return out(EmptyTArray(dtype))
+
+
+@register_op('write_to_array')
+def _write_to_array(ctx, ins, attrs):
+    arr = first(ins, 'X' if 'X' in ins else 'Array')
+    x = first(ins, 'V' if 'V' in ins else 'X')
+    i = _as_index(first(ins, 'I'))
+    x = jnp.asarray(x)
+    if isinstance(arr, EmptyTArray):
+        cap = int(attrs.get('capacity', DEFAULT_CAPACITY))
+        arr = TArray(jnp.zeros((cap,) + x.shape, dtype=x.dtype),
+                     jnp.asarray(0, jnp.int32))
+    elif not isinstance(arr, TArray):
+        raise TypeError("write_to_array target is not a tensor array")
+    if x.shape != arr.data.shape[1:]:
+        raise ValueError(
+            "write_to_array shape %s != array element shape %s" %
+            (x.shape, arr.data.shape[1:]))
+    data = jax.lax.dynamic_update_index_in_dim(
+        arr.data, x.astype(arr.data.dtype), i, 0)
+    size = jnp.maximum(arr.size, i + 1)
+    return out(TArray(data, size))
+
+
+@register_op('read_from_array')
+def _read_from_array(ctx, ins, attrs):
+    arr = first(ins, 'X' if 'X' in ins else 'Array')
+    i = _as_index(first(ins, 'I'))
+    return out(jax.lax.dynamic_index_in_dim(arr.data, i, 0,
+                                            keepdims=False))
+
+
+@register_op('array_length')
+def _array_length(ctx, ins, attrs):
+    arr = first(ins, 'X')
+    return out(arr.size.reshape(1).astype(jnp.int32))
+
+
+@register_op('lod_tensor_to_array')
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """Split padded [B, T, ...] into a T-entry array of [B, ...] steps.
+
+    The reference splits by LoD rank table (sequences sorted desc by
+    length, each entry holding the still-active rows); on TPU we keep the
+    batch dense — entry t is simply timestep t for all rows and masking
+    handles inactive rows downstream (see DynamicRNN)."""
+    x = first(ins, 'X')
+    data = jnp.moveaxis(x, 1, 0)  # [T, B, ...]
+    return out(TArray(data, jnp.asarray(x.shape[1], jnp.int32)))
+
+
+@register_op('array_to_lod_tensor')
+def _array_to_lod_tensor(ctx, ins, attrs):
+    arr = first(ins, 'X')
+    return out(jnp.moveaxis(arr.data, 0, 1))  # [B, T, ...]
+
+
+@register_op('lod_rank_table')
+def _lod_rank_table(ctx, ins, attrs):
+    """The reference rank table sorts sequences by length for batch
+    shrinking.  The TPU representation is just the lengths vector (no
+    reordering — masks replace shrinking); ops that consume the table
+    (max_sequence_len, shrink_memory) read it directly."""
+    x = first(ins, 'X')
+    ln = first(ins, 'XLen')
+    if ln is None:
+        ln = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return out(ln.astype(jnp.int32))
+
+
+@register_op('max_sequence_len')
+def _max_sequence_len(ctx, ins, attrs):
+    table = first(ins, 'RankTable')
+    return out(jnp.max(table).reshape(1).astype(jnp.int32))
+
+
+@register_op('shrink_rnn_memory')
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """Reference: drops finished sequences' rows at step I.  Dense-batch
+    equivalent: zero the memory rows whose sequence already ended (the
+    scan carries full batch; masking preserves numerics)."""
+    x = first(ins, 'X')
+    table = first(ins, 'RankTable')
+    i = _as_index(first(ins, 'I'))
+    active = (table > i)
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    return out(jnp.where(active.reshape(shape), x, 0))
