@@ -8,8 +8,6 @@ from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
-from .beam_search import *  # noqa: F401,F403
-from . import beam_search as _bs
 
 __all__ = []
 __all__ += control_flow.__all__
@@ -18,4 +16,3 @@ __all__ += nn.__all__
 __all__ += ops.__all__
 __all__ += sequence.__all__
 __all__ += tensor.__all__
-__all__ += _bs.__all__
