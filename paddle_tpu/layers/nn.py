@@ -64,7 +64,11 @@ def fc(input,
                          outputs={'Out': [pre_bias]})
         if lod > 0:
             _copy_len(helper, mul_results[0], pre_bias)
-    pre_activation = helper.append_bias_op(pre_bias, dim_start=flatten)
+    # Bias broadcasts over everything left of the size dim; base it on the
+    # combined lod (pre_bias is [B, T, size] if ANY input was ragged), not
+    # on whichever input the loop visited last.
+    bias_dim = len(pre_bias.shape) - 1 if lod > 0 else num_flatten_dims
+    pre_activation = helper.append_bias_op(pre_bias, dim_start=bias_dim)
     return helper.append_activation(pre_activation)
 
 

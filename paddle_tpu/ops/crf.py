@@ -132,9 +132,11 @@ def _crf_decoding(ctx, ins, attrs):
     if label is not None:
         if label.ndim == 3:
             label = label[..., 0]
-        # parity with crf_decoding_op: with Label, emit 1 where the Viterbi
-        # tag DISAGREES with the gold tag (an error indicator per step)
+        # parity with crf_decoding_op.h (`path[i] = label[i] == path[i]`):
+        # with Label, emit 1 where the Viterbi tag AGREES with the gold tag.
+        # Padded positions are forced to 0 (the reference compares over the
+        # flat LoD layout and has no padding to speak of).
         mask = jnp.arange(emission.shape[1])[None, :] < lengths[:, None]
-        err = (path != label.astype(jnp.int32)) & mask
-        return {'ViterbiPath': [err.astype(jnp.int32)[..., None]]}
+        hit = (path == label.astype(jnp.int32)) & mask
+        return {'ViterbiPath': [hit.astype(jnp.int32)[..., None]]}
     return {'ViterbiPath': [path[..., None]]}

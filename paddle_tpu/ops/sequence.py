@@ -216,12 +216,6 @@ def _lod_reset(ctx, ins, attrs):
     return {'Out': [x], 'OutLen': [target.astype(jnp.int32).reshape(-1)]}
 
 
-@register_op('max_sequence_len')
-def _max_sequence_len(ctx, ins, attrs):
-    x = first(ins, 'RankTable')
-    return out(jnp.max(x.astype(jnp.int32)).reshape((1,)))
-
-
 @register_op('sequence_first_step')
 def _sequence_first_step(ctx, ins, attrs):
     return _pool_shim(ctx, ins, 'FIRST')

@@ -207,12 +207,6 @@ _logical('xor', jnp.logical_xor)
 _logical('not', jnp.logical_not, binary=False)
 
 
-@register_op('is_empty')
-def _is_empty(ctx, ins, attrs):
-    x = first(ins, 'X')
-    return out(jnp.asarray(x.size == 0))
-
-
 @register_op('sign_of')
 def _sign_of(ctx, ins, attrs):
     return out(jnp.sign(first(ins, 'X')))
@@ -223,13 +217,6 @@ def _sequence_reshape(ctx, ins, attrs):
     x = first(ins, 'X')
     new_dim = attrs['new_dim']
     return out(x.reshape(x.shape[0], -1, new_dim))
-
-
-@register_op('print')
-def _print(ctx, ins, attrs):
-    x = first(ins, 'X')
-    jax.debug.print(attrs.get('message', '') + " {}", x)
-    return out(x)
 
 
 @register_op('im2sequence')

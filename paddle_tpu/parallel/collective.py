@@ -44,7 +44,16 @@ def allreduce(x, axis_name, op='sum'):
     if op == 'min':
         return lax.pmin(x, axis_name)
     if op == 'prod':
-        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+        # Exact for negatives and zeros: combine sign via parity of the
+        # negative count, magnitude via sum of log|x| with zeros masked.
+        is_zero = (x == 0)
+        neg = lax.psum((x < 0).astype(jnp.int32), axis_name)
+        any_zero = lax.pmax(is_zero.astype(jnp.int32), axis_name)
+        logmag = lax.psum(jnp.where(is_zero, 0.0, jnp.log(jnp.abs(
+            jnp.where(is_zero, 1.0, x)))), axis_name)
+        sign = jnp.where(neg % 2 == 1, -1.0, 1.0)
+        return jnp.where(any_zero > 0, 0.0,
+                         sign * jnp.exp(logmag)).astype(x.dtype)
     raise ValueError("unsupported allreduce op %r" % op)
 
 

@@ -1,0 +1,21 @@
+"""HEAD must always import: duplicate op registrations or missing modules
+die here before anything else runs (round-1 regression guard)."""
+
+
+def test_import_paddle_tpu():
+    import paddle_tpu  # noqa: F401
+    import paddle_tpu.layers  # noqa: F401
+    import paddle_tpu.models  # noqa: F401
+    import paddle_tpu.parallel  # noqa: F401
+    import paddle_tpu.datasets  # noqa: F401
+
+
+def test_import_graft_entry():
+    import __graft_entry__  # noqa: F401
+
+
+def test_registry_has_core_ops():
+    from paddle_tpu.core.registry import get_op_impl
+    for name in ['mul', 'conv2d', 'softmax', 'max_sequence_len', 'is_empty',
+                 'print', 'lookup_table', 'while', 'beam_search']:
+        assert get_op_impl(name) is not None
