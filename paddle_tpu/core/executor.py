@@ -263,6 +263,12 @@ def _to_feed_arrays(name, value, var):
     """Convert one feed entry to {name: array} (+ companion lengths for
     ragged feeds)."""
     out = {}
+    if isinstance(value, jax.Array):
+        # Already device-resident (staged by the caller or a prefetch
+        # reader): pass through untouched — np.asarray here would drag it
+        # back to host and re-upload it every step.
+        out[name] = value
+        return out
     if isinstance(value, LoDTensor):
         out[name] = _np_to_device_dtype(value.padded(), var)
         if value.is_ragged():
@@ -330,10 +336,19 @@ class Executor(object):
 
         block = program.global_block()
 
+        dev = self.place.jax_device()
         feed_arrays = {}
         for name, value in feed.items():
             var = block.vars.get(name)
             feed_arrays.update(_to_feed_arrays(name, value, var))
+        # Commit feeds explicitly: an async device_put is ~10x faster than
+        # letting jit transfer numpy args in-line, and committed inputs pin
+        # the computation to `place` without a jax.default_device context
+        # (which defeats jit's C++ fast-path dispatch — measured 9.7s/step
+        # vs 60ms on a tunneled v5e).
+        feed_arrays = {k: (v if isinstance(v, jax.Array)
+                           else jax.device_put(v, dev))
+                       for k, v in feed_arrays.items()}
 
         plan = self._get_plan(program, block, scope, feed_arrays,
                               tuple(fetch_names), use_program_cache)
@@ -341,11 +356,10 @@ class Executor(object):
 
         state_rw = {n: scope.get(n) for n in state_rw_names}
         state_ro = {n: scope.get(n) for n in state_ro_names}
-        rng_key = self._rng_key(program)
+        rng_key = jax.device_put(self._rng_key(program), dev)
         self._step += 1
 
-        with jax.default_device(self.place.jax_device()):
-            fetches, new_state = fn(feed_arrays, state_rw, state_ro, rng_key)
+        fetches, new_state = fn(feed_arrays, state_rw, state_ro, rng_key)
 
         for n, v in new_state.items():
             scope.set(n, v)
