@@ -492,3 +492,5 @@ class Executor(object):
 
     def close(self):
         self._cache.clear()
+        if hasattr(self, '_sharded_cache'):
+            self._sharded_cache.clear()

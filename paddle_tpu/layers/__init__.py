@@ -1,7 +1,9 @@
 """Layer library (parity with python/paddle/v2/fluid/layers)."""
 from .. import ops as _ops  # ensure op registry is populated  # noqa: F401
 
+from . import beam_search as _beam_search_mod
 from . import control_flow, io, nn, ops, sequence, tensor
+from .beam_search import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
@@ -10,6 +12,7 @@ from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 
 __all__ = []
+__all__ += _beam_search_mod.__all__
 __all__ += control_flow.__all__
 __all__ += io.__all__
 __all__ += nn.__all__

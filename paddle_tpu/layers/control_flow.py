@@ -46,14 +46,17 @@ def create_array(dtype='float32', **kwargs):
     return arr
 
 
-def array_write(x, i, array=None, **kwargs):
+def array_write(x, i, array=None, capacity=None, **kwargs):
+    """`capacity` bounds the buffer allocated by a first write (e.g. a
+    beam-search decode loop's max_len); default DEFAULT_CAPACITY."""
     helper = LayerHelper('array_write', **kwargs)
     if array is None:
         array = create_array(dtype=x.dtype)
+    attrs = {} if capacity is None else {'capacity': int(capacity)}
     helper.append_op(
         type='write_to_array',
         inputs={'Array': [array], 'V': [x], 'I': [i]},
-        outputs={'Out': [array]}, infer_shape=False)
+        outputs={'Out': [array]}, attrs=attrs, infer_shape=False)
     return array
 
 
@@ -184,14 +187,23 @@ class WhileGuard(BlockGuard):
 
     def __enter__(self):
         self.while_op.status = While.IN_WHILE_BLOCK
-        return super(WhileGuard, self).__enter__()
+        ret = super(WhileGuard, self).__enter__()
+        self.while_op.sub_block_idx = \
+            self.main_program.current_block().idx
+        return ret
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         if exc_type is not None:
+            # still roll back so the builder isn't left inside the
+            # abandoned sub-block
+            self.main_program.rollback()
             return False
         self.while_op.status = While.AFTER_WHILE_BLOCK
+        # roll back to the parent block FIRST so the `while` op itself
+        # lands in the parent, then emit it
+        ret = super(WhileGuard, self).__exit__(exc_type, exc_val, exc_tb)
         self.while_op.complete()
-        return super(WhileGuard, self).__exit__(exc_type, exc_val, exc_tb)
+        return ret
 
 
 class While(object):
@@ -232,8 +244,6 @@ class While(object):
         return None
 
     def complete(self):
-        main_program = self.helper.main_program
-        while_block = main_program.current_block()
         max_iters = self.max_iters
         if max_iters is None:
             max_iters = self._infer_max_iters()
@@ -241,7 +251,7 @@ class While(object):
             type='while',
             inputs={'Condition': [self.cond_var]},
             outputs={},
-            attrs={'sub_block': while_block.idx,
+            attrs={'sub_block': self.sub_block_idx,
                    'condition': self.cond_var.name,
                    'max_iters': max_iters},
             infer_shape=False)

@@ -9,53 +9,95 @@ is the batched Luong form — decoder GRU runs over the whole (teacher
 -forced) target in one `lax.scan`, then attention over the padded encoder
 states is ONE [B,Td,H]x[B,H,Ts] matmul (MXU) with a length mask — which is
 mathematically the same attention, but rides two large matmuls instead of
-Ts small ones.  Generation-time beam search lives in
-`layers.beam_search` (static-shape scan, models/seq2seq.py: decode()).
+Ts small ones.  Generation (`decode`) runs the same shared-weight decoder
+cell step-by-step inside a `layers.While` loop (lowered to one
+`lax.scan`), pruning a dense [B, K] beam lattice per step with
+`layers.beam_search` and backtracking with `layers.beam_search_decode` —
+the static-shape counterpart of beam_search_op.cc's host-side LoD pruning.
+All parameters carry fixed names (``mt_*``) so a decode program built in
+the same scope reuses the trained weights.
 """
 import paddle_tpu as fluid
+from paddle_tpu.param_attr import ParamAttr
 
-__all__ = ['encoder', 'train_net', 'build']
+__all__ = ['encoder', 'train_net', 'build', 'decode']
+
+
+def _attr(name):
+    return ParamAttr(name=name)
 
 
 def encoder(src_word_id, dict_size, word_dim=32, hidden_dim=32):
     src_embedding = fluid.layers.embedding(
-        input=src_word_id, size=[dict_size, word_dim], dtype='float32')
+        input=src_word_id, size=[dict_size, word_dim], dtype='float32',
+        param_attr=_attr('mt_src_emb'))
     fc_forward = fluid.layers.fc(
-        input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2)
-    src_forward = fluid.layers.dynamic_gru(input=fc_forward, size=hidden_dim)
+        input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2,
+        param_attr=_attr('mt_enc_fc_fwd_w'),
+        bias_attr=_attr('mt_enc_fc_fwd_b'))
+    src_forward = fluid.layers.dynamic_gru(
+        input=fc_forward, size=hidden_dim,
+        param_attr=_attr('mt_enc_gru_fwd_w'),
+        bias_attr=_attr('mt_enc_gru_fwd_b'))
     fc_backward = fluid.layers.fc(
-        input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2)
+        input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2,
+        param_attr=_attr('mt_enc_fc_bwd_w'),
+        bias_attr=_attr('mt_enc_fc_bwd_b'))
     src_backward = fluid.layers.dynamic_gru(
-        input=fc_backward, size=hidden_dim, is_reverse=True)
+        input=fc_backward, size=hidden_dim, is_reverse=True,
+        param_attr=_attr('mt_enc_gru_bwd_w'),
+        bias_attr=_attr('mt_enc_gru_bwd_b'))
     encoded = fluid.layers.concat(input=[src_forward, src_backward], axis=2)
     return encoded
 
 
-def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32):
-    encoded = encoder(src, dict_size, word_dim, hidden_dim)
-
-    # decoder init state from the encoder's last step
+def _decoder_init(encoded, hidden_dim):
+    """Decoder h0 from the encoder's last step (shared weights)."""
     enc_last = fluid.layers.sequence_last_step(input=encoded)
-    dec_h0 = fluid.layers.fc(input=enc_last, size=hidden_dim, act='tanh')
+    return fluid.layers.fc(input=enc_last, size=hidden_dim, act='tanh',
+                           param_attr=_attr('mt_dec_h0_w'),
+                           bias_attr=_attr('mt_dec_h0_b'))
 
-    trg_embedding = fluid.layers.embedding(
-        input=trg, size=[dict_size, word_dim], dtype='float32')
-    dec_fc = fluid.layers.fc(
-        input=trg_embedding, size=hidden_dim * 3, num_flatten_dims=2)
-    dec_out = fluid.layers.dynamic_gru(
-        input=dec_fc, size=hidden_dim, h_0=dec_h0)
 
-    # Luong attention: scores over padded encoder states, masked softmax
-    enc_proj = fluid.layers.fc(
-        input=encoded, size=hidden_dim, num_flatten_dims=2)
-    scores = fluid.layers.matmul(dec_out, enc_proj, transpose_y=True)
+def _enc_proj(encoded, hidden_dim):
+    return fluid.layers.fc(input=encoded, size=hidden_dim,
+                           num_flatten_dims=2,
+                           param_attr=_attr('mt_enc_proj_w'),
+                           bias_attr=_attr('mt_enc_proj_b'))
+
+
+def _attend_and_score(dec_states, encoded, enc_proj, dict_size):
+    """Shared attention + vocab head: dec_states [B, Td|K, H] against the
+    padded encoder states — Luong scores, masked softmax, context concat,
+    softmax output fc.  Used verbatim by BOTH the teacher-forced train
+    path and the per-step beam decode so the two can never drift."""
+    scores = fluid.layers.matmul(dec_states, enc_proj, transpose_y=True)
     attn = fluid.layers.sequence_softmax(
         input=scores, length_input=encoded, axis=2)
     context = fluid.layers.matmul(attn, encoded)
-    combined = fluid.layers.concat(input=[dec_out, context], axis=2)
+    combined = fluid.layers.concat(input=[dec_states, context], axis=2)
+    return fluid.layers.fc(
+        input=combined, size=dict_size, num_flatten_dims=2, act='softmax',
+        param_attr=_attr('mt_out_fc_w'), bias_attr=_attr('mt_out_fc_b'))
 
-    prediction = fluid.layers.fc(
-        input=combined, size=dict_size, num_flatten_dims=2, act='softmax')
+
+def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32):
+    encoded = encoder(src, dict_size, word_dim, hidden_dim)
+    dec_h0 = _decoder_init(encoded, hidden_dim)
+
+    trg_embedding = fluid.layers.embedding(
+        input=trg, size=[dict_size, word_dim], dtype='float32',
+        param_attr=_attr('mt_trg_emb'))
+    dec_fc = fluid.layers.fc(
+        input=trg_embedding, size=hidden_dim * 3, num_flatten_dims=2,
+        param_attr=_attr('mt_dec_fc_w'), bias_attr=_attr('mt_dec_fc_b'))
+    dec_out = fluid.layers.dynamic_gru(
+        input=dec_fc, size=hidden_dim, h_0=dec_h0,
+        param_attr=_attr('mt_dec_gru_w'), bias_attr=_attr('mt_dec_gru_b'))
+
+    # Luong attention: scores over padded encoder states, masked softmax
+    enc_proj = _enc_proj(encoded, hidden_dim)
+    prediction = _attend_and_score(dec_out, encoded, enc_proj, dict_size)
     cost = fluid.layers.cross_entropy(input=prediction, label=label)
     avg_cost = fluid.layers.mean(
         x=fluid.layers.sequence_pool(input=cost, pool_type='sum'))
@@ -73,3 +115,76 @@ def build(dict_size, word_dim=32, hidden_dim=32):
     prediction, avg_cost = train_net(src, trg, label, dict_size, word_dim,
                                      hidden_dim)
     return src, trg, label, prediction, avg_cost
+
+
+def decode(src, dict_size, word_dim=32, hidden_dim=32, beam_size=4,
+           max_len=16, start_id=0, end_id=1):
+    """Beam-search generation program (reference book decode path).
+
+    Builds the shared-weight decoder unrolled as a While loop: each tick
+    embeds the current [B, K] beam tokens, advances the GRU cell, attends
+    over the encoder states, scores the vocab, and prunes to the top K
+    continuations.  Returns (sentence_ids [B, K, max_len] end_id-padded,
+    sentence_scores [B, K]) best-first along K.
+    """
+    layers = fluid.layers
+    encoded = encoder(src, dict_size, word_dim, hidden_dim)
+    dec_h0 = _decoder_init(encoded, hidden_dim)          # [B, H]
+    enc_proj = _enc_proj(encoded, hidden_dim)            # [B, Ts, H]
+
+    pre_ids, pre_scores = layers.beam_search_init(
+        dec_h0, beam_size=beam_size, start_id=start_id)  # [B, K]
+    hidden = layers.expand(
+        layers.reshape(dec_h0, shape=[-1, 1, hidden_dim]),
+        expand_times=[1, beam_size, 1])                   # [B, K, H]
+
+    counter = layers.zeros(shape=[1], dtype='int64')
+    limit = layers.fill_constant(shape=[1], dtype='int64', value=max_len)
+    cond = layers.less_than(x=counter, y=limit)
+
+    ids_arr = layers.create_array('int64')
+    parents_arr = layers.create_array('int64')
+    scores_arr = layers.create_array('float32')
+
+    while_op = layers.While(cond=cond, max_iters=max_len)
+    with while_op.block():
+        emb = layers.embedding(
+            input=pre_ids, size=[dict_size, word_dim], dtype='float32',
+            param_attr=_attr('mt_trg_emb'))
+        # lookup_table squeezes a trailing size-1 axis (fluid's [N, 1] id
+        # convention) which eats the beam axis when K == 1 — restore it
+        emb = layers.reshape(emb, shape=[-1, beam_size, word_dim])
+        step_fc = layers.fc(
+            input=emb, size=hidden_dim * 3, num_flatten_dims=2,
+            param_attr=_attr('mt_dec_fc_w'), bias_attr=_attr('mt_dec_fc_b'))
+        flat_in = layers.reshape(step_fc, shape=[-1, hidden_dim * 3])
+        flat_h = layers.reshape(hidden, shape=[-1, hidden_dim])
+        new_h_flat, _, _ = layers.gru_unit(
+            input=flat_in, hidden=flat_h, size=hidden_dim * 3,
+            param_attr=_attr('mt_dec_gru_w'),
+            bias_attr=_attr('mt_dec_gru_b'))              # [B*K, H]
+        new_h = layers.reshape(new_h_flat,
+                               shape=[-1, beam_size, hidden_dim])
+
+        probs = _attend_and_score(new_h, encoded, enc_proj, dict_size)
+        logp = layers.log(probs)                          # [B, K, V]
+
+        sel_ids, sel_scores, parents = layers.beam_search(
+            pre_ids=pre_ids, pre_scores=pre_scores, scores=logp,
+            beam_size=beam_size, end_id=end_id)
+
+        layers.array_write(sel_ids, counter, ids_arr, capacity=max_len)
+        layers.array_write(parents, counter, parents_arr, capacity=max_len)
+        layers.array_write(sel_scores, counter, scores_arr,
+                           capacity=max_len)
+
+        # carry: beams + beam-reordered decoder state
+        layers.assign(layers.beam_gather(new_h, parents), hidden)
+        layers.assign(sel_ids, pre_ids)
+        layers.assign(sel_scores, pre_scores)
+        layers.increment(x=counter, value=1, in_place=True)
+        layers.less_than(x=counter, y=limit, cond=cond)
+
+    seq_ids, seq_scores = layers.beam_search_decode(
+        ids_arr, parents_arr, scores_arr, end_id=end_id)
+    return seq_ids, seq_scores

@@ -78,6 +78,32 @@ def beam_search_backtrack(ids_tbk, parents_tbk, steps, end_id):
     return jnp.moveaxis(toks, 0, 2)  # [B, K, T] in forward order
 
 
+@register_op('beam_search_init')
+def _beam_search_init(ctx, ins, attrs):
+    """Seed the dense beam lattice: ids [B, K] all start_id; scores [B, K]
+    with column 0 at 0.0 and the rest NEG_INF so step 1 expands only one
+    beam (the reference gets this for free from its LoD nesting —
+    beam_search_op.cc grows real beams lazily)."""
+    ref = first(ins, 'X')  # any [B, ...] tensor; batch size source
+    beam_size = int(attrs['beam_size'])
+    start_id = int(attrs['start_id'])
+    B = ref.shape[0]
+    ids = jnp.full((B, beam_size), start_id, jnp.int32)
+    scores = jnp.full((B, beam_size), NEG_INF, jnp.float32)
+    scores = scores.at[:, 0].set(0.0)
+    return {'Ids': [ids], 'Scores': [scores]}
+
+
+@register_op('beam_gather')
+def _beam_gather(ctx, ins, attrs):
+    """Reorder per-beam state [B, K, ...] by parent indices [B, K] — the
+    state shuffle the reference does on the host when pruning LoD beams."""
+    x = first(ins, 'X')
+    idx = first(ins, 'Index').astype(jnp.int32)
+    idxe = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {'Out': [jnp.take_along_axis(x, idxe, axis=1)]}
+
+
 @register_op('beam_search_decode')
 def _beam_search_decode(ctx, ins, attrs):
     ids_arr = first(ins, 'Ids')  # TArray [T, B, K] (or raw array)
@@ -93,7 +119,6 @@ def _beam_search_decode(ctx, ins, attrs):
         steps = jnp.asarray(ids_tbk.shape[0], jnp.int32)
     seqs = beam_search_backtrack(ids_tbk, parents_tbk, steps, end_id)
     if isinstance(scores_arr, TArray):
-        T = scores_arr.capacity
         last = jnp.maximum(scores_arr.size - 1, 0)
         final_scores = jax.lax.dynamic_index_in_dim(
             scores_arr.data, last, 0, keepdims=False)  # [B, K]

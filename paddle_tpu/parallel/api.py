@@ -110,11 +110,42 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
              for n, v in state_ro.items()}
     key_sh = replicate(mesh)
 
-    fn = jax.jit(
-        raw_fn,
-        in_shardings=(feed_sh, rw_sh, ro_sh, key_sh),
-        donate_argnums=(1,) if donate else ())
+    # one sharded jit per (program version, mesh, axes, arg signature) —
+    # multi-step training reuses the compiled executable instead of
+    # re-jitting (and thus recompiling) every call
+    cache = getattr(exe, '_sharded_cache', None)
+    if cache is None:
+        cache = exe._sharded_cache = {}
+    sig = tuple((n, np.shape(v), str(np.asarray(v).dtype) if not
+                 hasattr(v, 'dtype') else str(v.dtype))
+                for d in (feed_arrays, state_rw, state_ro)
+                for n, v in sorted(d.items()))
+    key = (id(program), program.version, id(mesh), batch_axis, param_axis,
+           tuple(fetch_list_name(f) for f in fetch_list), donate, sig)
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(
+            raw_fn,
+            in_shardings=(feed_sh, rw_sh, ro_sh, key_sh),
+            donate_argnums=(1,) if donate else ())
+        cache[key] = fn
+
+    # stage args onto the mesh explicitly: jit refuses committed
+    # single-device arrays whose placement disagrees with in_shardings
+    feed_arrays = {n: jax.device_put(v, feed_sh[n])
+                   for n, v in feed_arrays.items()}
+    state_rw = {n: jax.device_put(v, rw_sh[n])
+                for n, v in state_rw.items()}
+    state_ro = {n: jax.device_put(v, ro_sh[n])
+                for n, v in state_ro.items()}
+    rng_key = jax.device_put(rng_key, key_sh)
+
     fetches, new_state = fn(feed_arrays, state_rw, state_ro, rng_key)
+    exe._step += 1  # advance the PRNG chain (dropout etc.) across steps
     for n, v in new_state.items():
         scope.set(n, v)
     return [np.asarray(v) for v in fetches]
+
+
+def fetch_list_name(f):
+    return getattr(f, 'name', str(f))

@@ -29,3 +29,42 @@ def test_machine_translation_trains():
             costs.append(float(np.ravel(c)[0]))
     assert np.mean(costs[-8:]) < np.mean(costs[:8]), \
         (np.mean(costs[:8]), np.mean(costs[-8:]))
+
+    # --- generation: beam-search decode with the trained weights ---
+    # (reference book test_machine_translation.py decode path)
+    max_len, beam_size = 8, 4
+    decode_prog = fluid.Program()
+    decode_startup = fluid.Program()
+    with fluid.program_guard(decode_prog, decode_startup):
+        src_d = fluid.layers.data(name='src_word_id', shape=[1],
+                                  dtype='int64', lod_level=1)
+        seq_ids, seq_scores = models.seq2seq.decode(
+            src_d, DICT_SIZE, beam_size=beam_size, max_len=max_len,
+            start_id=0, end_id=1)
+    src_batch = [([2, 3, 4, 5],), ([6, 7],), ([8, 9, 10],)]
+    dec_feeder = fluid.DataFeeder(place=place, feed_list=[src_d])
+    ids, scores = exe.run(decode_prog, feed=dec_feeder.feed(src_batch),
+                          fetch_list=[seq_ids, seq_scores])
+    ids, scores = np.asarray(ids), np.asarray(scores)
+    assert ids.shape == (3, beam_size, max_len)
+    assert ids.dtype.kind in 'iu'
+    assert np.all(np.isfinite(scores))
+    # beams come back best-first
+    assert np.all(np.diff(scores, axis=1) <= 1e-5)
+
+    # greedy decoding (beam_size=1) exercises the K == 1 lattice path
+    # (note: best-of-K >= greedy is NOT asserted — beam search is not
+    # monotone in beam size)
+    greedy_prog = fluid.Program()
+    with fluid.program_guard(greedy_prog, fluid.Program()):
+        src_g = fluid.layers.data(name='src_word_id', shape=[1],
+                                  dtype='int64', lod_level=1)
+        g_ids, g_scores = models.seq2seq.decode(
+            src_g, DICT_SIZE, beam_size=1, max_len=max_len,
+            start_id=0, end_id=1)
+    g_feeder = fluid.DataFeeder(place=place, feed_list=[src_g])
+    gi, gs = exe.run(greedy_prog, feed=g_feeder.feed(src_batch),
+                     fetch_list=[g_ids, g_scores])
+    gi, gs = np.asarray(gi), np.asarray(gs)
+    assert gi.shape == (3, 1, max_len)
+    assert np.all(np.isfinite(gs))
