@@ -394,7 +394,7 @@ class Program(object):
 
     def clone(self, for_test=False):
         """Deep-copy the program.  With for_test=True, flip every op's
-        `is_test` attr (dropout becomes identity, batch_norm uses running
+        `is_test` attr (dropout scales by keep-prob, batch_norm uses running
         stats) — parity with fluid Program.clone + inference_optimize."""
         p = copy.deepcopy(self)
         if for_test:

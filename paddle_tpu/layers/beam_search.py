@@ -27,7 +27,7 @@ def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None,
     already emitted `end_id`) freeze their score and only propose
     `end_id`, matching beam_search_op.cc's pruning of ended hypotheses.
     """
-    helper = LayerHelper('beam_search', **kwargs)
+    helper = LayerHelper('beam_search', name=name, **kwargs)
     ids = helper.create_tmp_variable('int64')
     sel_scores = helper.create_tmp_variable('float32')
     parents = helper.create_tmp_variable('int64')

@@ -62,12 +62,16 @@ def _truncated_gaussian_random(ctx, ins, attrs):
 def _dropout(ctx, ins, attrs):
     x = first(ins, 'X')
     p = attrs.get('dropout_prob', 0.5)
-    if attrs.get('is_test', False) or p == 0.0:
+    if p == 0.0:
         return {'Out': [x], 'Mask': [jnp.ones_like(x)]}
+    if attrs.get('is_test', False):
+        # reference dropout_op.h test path: Out = X * (1 - p) (non-inverted)
+        return {'Out': [(x * (1.0 - p)).astype(x.dtype)],
+                'Mask': [jnp.ones_like(x)]}
     keep = 1.0 - p
     mask = jax.random.bernoulli(_key(ctx, attrs), keep, x.shape)
-    # reference keeps scale at train time (inverted dropout)
-    y = jnp.where(mask, x / keep, jnp.zeros_like(x))
+    # reference dropout_op.h train path: Out = X * Mask, no 1/keep rescale
+    y = jnp.where(mask, x, jnp.zeros_like(x))
     return {'Out': [y.astype(x.dtype)], 'Mask': [mask.astype(x.dtype)]}
 
 

@@ -121,7 +121,8 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
                 for d in (feed_arrays, state_rw, state_ro)
                 for n, v in sorted(d.items()))
     key = (id(program), program.version, id(mesh), batch_axis, param_axis,
-           tuple(fetch_list_name(f) for f in fetch_list), donate, sig)
+           tuple(getattr(f, 'name', str(f)) for f in fetch_list), donate,
+           sig)
     fn = cache.get(key)
     if fn is None:
         fn = jax.jit(
@@ -139,13 +140,13 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
     state_ro = {n: jax.device_put(v, ro_sh[n])
                 for n, v in state_ro.items()}
     rng_key = jax.device_put(rng_key, key_sh)
+    # write staged read-only state back so later steps find it already on
+    # the mesh and the device_puts above become no-ops
+    for n, v in state_ro.items():
+        scope.set(n, v)
 
     fetches, new_state = fn(feed_arrays, state_rw, state_ro, rng_key)
     exe._step += 1  # advance the PRNG chain (dropout etc.) across steps
     for n, v in new_state.items():
         scope.set(n, v)
     return [np.asarray(v) for v in fetches]
-
-
-def fetch_list_name(f):
-    return getattr(f, 'name', str(f))

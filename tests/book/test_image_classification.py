@@ -40,10 +40,4 @@ def test_image_classification(net):
             costs.append(float(np.ravel(c)[0]))
             accs.append(float(np.ravel(a)[0]))
     assert np.all(np.isfinite(costs))
-    if net == 'resnet':
-        assert np.mean(costs[-4:]) < np.mean(costs[:4])
-    else:
-        # VGG16's stacked 0.4/0.5 dropouts make per-batch cost too noisy
-        # for a monotone assertion in ~24 tiny CPU steps; assert training
-        # is stable (no divergence) — convergence is covered by resnet.
-        assert np.mean(costs[-8:]) < costs[0] + 0.5
+    assert np.mean(costs[-4:]) < np.mean(costs[:4])

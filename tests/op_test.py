@@ -34,8 +34,9 @@ def run_op(op_type, inputs, attrs=None, seed=0):
     impl = get_op_impl(op_type)
     ins = {}
     for slot, v in (inputs or {}).items():
-        vals = v if isinstance(v, (list, tuple)) else [v]
-        ins[slot] = [jnp.asarray(x) for x in vals]
+        vals = v if isinstance(v, list) else [v]
+        ins[slot] = [tuple(jnp.asarray(e) for e in x) if isinstance(x, tuple)
+                     else jnp.asarray(x) for x in vals]
     outs = impl.compute(_Ctx(seed), ins, dict(attrs or {}))
     return outs
 

@@ -79,4 +79,5 @@ def test_dropout_train_vs_test():
 
     test_prog = fluid.default_main_program().inference_optimize()
     out2, = exe.run(test_prog, feed={'x': xv}, fetch_list=[d.name])
-    np.testing.assert_allclose(out2, xv)  # no dropout at inference
+    # reference dropout_op.h is_test path: Out = X * (1 - p)
+    np.testing.assert_allclose(out2, xv * 0.5)

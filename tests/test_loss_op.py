@@ -1,0 +1,170 @@
+"""Loss op numeric tests vs numpy references.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{cross_entropy,
+sigmoid_cross_entropy_with_logits,smooth_l1_loss,hinge_loss,huber_loss,
+log_loss,rank_loss,margin_rank_loss,modified_huber_loss,squared_l2_distance,
+nce}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op, OpTest
+
+rng = np.random.RandomState(7)
+
+
+def test_cross_entropy():
+    x = rng.uniform(0.05, 1.0, (6, 5)).astype('float32')
+    x /= x.sum(axis=1, keepdims=True)
+    lab = rng.randint(0, 5, (6, 1)).astype('int64')
+    got = np.asarray(run_op('cross_entropy', {'X': x, 'Label': lab})['Y'][0])
+    want = -np.log(x[np.arange(6), lab[:, 0]] + 1e-12)[:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_soft_label():
+    x = rng.uniform(0.05, 1.0, (4, 5)).astype('float32')
+    x /= x.sum(axis=1, keepdims=True)
+    lab = rng.uniform(0, 1, (4, 5)).astype('float32')
+    lab /= lab.sum(axis=1, keepdims=True)
+    got = np.asarray(run_op('cross_entropy', {'X': x, 'Label': lab},
+                            {'soft_label': True})['Y'][0])
+    want = -(lab * np.log(x + 1e-12)).sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_with_cross_entropy():
+    logits = rng.randn(6, 9).astype('float32')
+    lab = rng.randint(0, 9, (6, 1)).astype('int64')
+    outs = run_op('softmax_with_cross_entropy',
+                  {'Logits': logits, 'Label': lab})
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    sm = e / e.sum(axis=1, keepdims=True)
+    want = -np.log(sm[np.arange(6), lab[:, 0]])[:, None]
+    np.testing.assert_allclose(np.asarray(outs['Loss'][0]), want,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs['Softmax'][0]), sm,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = rng.randn(5, 4).astype('float32')
+    lab = rng.randint(0, 2, (5, 4)).astype('float32')
+    got = np.asarray(run_op('sigmoid_cross_entropy_with_logits',
+                            {'X': x, 'Label': lab})['Out'][0])
+    want = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_square_error_cost():
+    x = rng.randn(5, 3).astype('float32')
+    y = rng.randn(5, 3).astype('float32')
+    got = np.asarray(run_op('square_error_cost', {'X': x, 'Y': y})['Out'][0])
+    np.testing.assert_allclose(got, (x - y) ** 2, rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_l1_loss():
+    x = rng.randn(4, 6).astype('float32')
+    y = rng.randn(4, 6).astype('float32')
+    got = np.asarray(run_op('smooth_l1_loss', {'X': x, 'Y': y},
+                            {'sigma': 1.0})['Out'][0])
+    d = x - y
+    ad = np.abs(d)
+    elem = np.where(ad < 1.0, 0.5 * d * d, ad - 0.5)
+    np.testing.assert_allclose(got, elem.sum(axis=1, keepdims=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_hinge_loss():
+    logits = rng.randn(7, 1).astype('float32')
+    lab = rng.randint(0, 2, (7, 1)).astype('float32')
+    got = np.asarray(run_op('hinge_loss',
+                            {'Logits': logits, 'Labels': lab})['Loss'][0])
+    want = np.maximum(0.0, 1.0 - (2 * lab - 1) * logits)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_huber_loss():
+    x = rng.randn(6, 1).astype('float32')
+    y = rng.randn(6, 1).astype('float32')
+    got = np.asarray(run_op('huber_loss', {'X': x, 'Y': y},
+                            {'delta': 0.5})['Out'][0])
+    r = y - x
+    ar = np.abs(r)
+    want = np.where(ar <= 0.5, 0.5 * r * r, 0.5 * (ar - 0.25))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_log_loss():
+    p = rng.uniform(0.05, 0.95, (8, 1)).astype('float32')
+    lab = rng.randint(0, 2, (8, 1)).astype('float32')
+    got = np.asarray(run_op('log_loss', {'Predicted': p, 'Labels': lab},
+                            {'epsilon': 1e-4})['Loss'][0])
+    want = -lab * np.log(p + 1e-4) - (1 - lab) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rank_loss():
+    lab = rng.randint(0, 2, (5, 1)).astype('float32')
+    left = rng.randn(5, 1).astype('float32')
+    right = rng.randn(5, 1).astype('float32')
+    got = np.asarray(run_op(
+        'rank_loss', {'Label': lab, 'Left': left, 'Right': right})['Out'][0])
+    d = left - right
+    want = np.log1p(np.exp(d)) - lab * d
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_margin_rank_loss():
+    lab = (rng.randint(0, 2, (5, 1)) * 2 - 1).astype('float32')
+    x1 = rng.randn(5, 1).astype('float32')
+    x2 = rng.randn(5, 1).astype('float32')
+    got = run_op('margin_rank_loss',
+                 {'Label': lab, 'X1': x1, 'X2': x2}, {'margin': 0.1})
+    want = np.maximum(0.0, -lab * (x1 - x2) + 0.1)
+    np.testing.assert_allclose(np.asarray(got['Out'][0]), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_modified_huber_loss():
+    x = rng.randn(9, 1).astype('float32')
+    y = rng.randint(0, 2, (9, 1)).astype('float32')
+    got = np.asarray(run_op('modified_huber_loss',
+                            {'X': x, 'Y': y})['Out'][0])
+    a = (2 * y - 1) * x
+    want = np.where(a < -1, -4 * a, np.where(a < 1, (1 - a) ** 2, 0.0))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_nce_runs_and_is_finite():
+    x = rng.randn(4, 8).astype('float32')
+    lab = rng.randint(0, 20, (4, 1)).astype('int64')
+    w = rng.randn(20, 8).astype('float32')
+    b = rng.randn(20).astype('float32')
+    got = run_op('nce', {'Input': x, 'Label': lab, 'Weight': w, 'Bias': b},
+                 {'num_neg_samples': 5, 'num_total_classes': 20})
+    cost = np.asarray(got['Cost'][0])
+    assert cost.shape == (4, 1)
+    assert np.all(np.isfinite(cost)) and np.all(cost > 0)
+
+
+class TestCrossEntropyGrad(OpTest):
+    op_type = 'cross_entropy'
+
+    def setup(self):
+        x = rng.uniform(0.1, 1.0, (4, 5)).astype('float32')
+        self.inputs = {'X': x / x.sum(axis=1, keepdims=True),
+                       'Label': rng.randint(0, 5, (4, 1)).astype('int64')}
+        self.attrs = {}
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(['X'], output_slot='Y')
+
+
+class TestSigmoidCEGrad(OpTest):
+    op_type = 'sigmoid_cross_entropy_with_logits'
+
+    def test_grad(self):
+        self.inputs = {'X': rng.randn(3, 4).astype('float32'),
+                       'Label': rng.randint(0, 2, (3, 4)).astype('float32')}
+        self.check_grad(['X'])

@@ -1,0 +1,111 @@
+"""Metric op tests vs hand-computed references.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{accuracy,auc,
+precision_recall,edit_distance,chunk_eval,positive_negative_pair}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(3)
+
+
+def test_accuracy():
+    idx = np.array([[0, 2], [1, 3], [4, 0], [2, 2]], dtype='int64')
+    lab = np.array([[2], [0], [4], [1]], dtype='int64')
+    outs = run_op('accuracy', {'Indices': idx, 'Label': lab})
+    assert float(outs['Accuracy'][0][0]) == 0.5  # rows 0 and 2 hit
+    assert int(outs['Correct'][0][0]) == 2
+    assert int(outs['Total'][0][0]) == 4
+
+
+def test_auc_perfect_and_random():
+    score = np.array([0.1, 0.2, 0.8, 0.9], dtype='float32')
+    label = np.array([0, 0, 1, 1], dtype='int64')
+    auc = float(run_op('auc', {'Out': score, 'Label': label})['AUC'][0][0])
+    assert auc > 0.95  # perfect separation
+    label_bad = np.array([1, 1, 0, 0], dtype='int64')
+    auc_bad = float(run_op('auc', {'Out': score,
+                                   'Label': label_bad})['AUC'][0][0])
+    assert auc_bad < 0.1
+
+
+def test_precision_recall():
+    pred = np.array([0, 1, 1, 2, 2, 2], dtype='int64')
+    lab = np.array([0, 1, 2, 2, 2, 0], dtype='int64')
+    outs = run_op('precision_recall',
+                  {'MaxProbs': np.zeros((6, 1), 'float32'),
+                   'Indices': pred, 'Labels': lab},
+                  {'class_number': 3})
+    m = np.asarray(outs['BatchMetrics'][0]).reshape(-1)
+    # micro precision == micro recall == accuracy == 4/6
+    np.testing.assert_allclose(m[3], 4.0 / 6.0, rtol=1e-5)
+    np.testing.assert_allclose(m[4], 4.0 / 6.0, rtol=1e-5)
+
+
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1))
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[m, n]
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0], [4, 5, 0, 0]], dtype='int64')
+    ref = np.array([[1, 3, 3, 2], [4, 5, 6, 0]], dtype='int64')
+    hlen = np.array([3, 2], dtype='int64')
+    rlen = np.array([4, 3], dtype='int64')
+    outs = run_op('edit_distance',
+                  {'Hyps': hyp, 'Refs': ref, 'HypsLen': hlen,
+                   'RefsLen': rlen}, {'normalized': False})
+    got = np.asarray(outs['Out'][0]).reshape(-1)
+    want = np.array([_levenshtein([1, 2, 3], [1, 3, 3, 2]),
+                     _levenshtein([4, 5], [4, 5, 6])])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # normalized divides by reference length
+    got_n = np.asarray(run_op(
+        'edit_distance', {'Hyps': hyp, 'Refs': ref, 'HypsLen': hlen,
+                          'RefsLen': rlen},
+        {'normalized': True})['Out'][0]).reshape(-1)
+    np.testing.assert_allclose(got_n, want / rlen, atol=1e-5)
+
+
+def test_chunk_eval_iob_exact_match():
+    # IOB, 2 types: tags B0=0 I0=1 B1=2 I1=3 O=4
+    # seq: [B0 I0 O B1] — inference identical → P=R=F1=1
+    lab = np.array([[0, 1, 4, 2]], dtype='int64')
+    outs = run_op('chunk_eval', {'Inference': lab.copy(), 'Label': lab},
+                  {'num_chunk_types': 2, 'chunk_scheme': 'IOB'})
+    assert float(outs['Precision'][0][0]) == 1.0
+    assert float(outs['Recall'][0][0]) == 1.0
+    assert int(outs['NumLabelChunks'][0][0]) == 2
+    assert int(outs['NumCorrectChunks'][0][0]) == 2
+
+
+def test_chunk_eval_iob_partial():
+    lab = np.array([[0, 1, 4, 2]], dtype='int64')   # chunks: [0,1]t0, [3]t1
+    inf = np.array([[0, 4, 4, 2]], dtype='int64')   # chunks: [0]t0, [3]t1
+    outs = run_op('chunk_eval', {'Inference': inf, 'Label': lab},
+                  {'num_chunk_types': 2, 'chunk_scheme': 'IOB'})
+    # only the [3] chunk matches exactly
+    assert int(outs['NumCorrectChunks'][0][0]) == 1
+    assert int(outs['NumInferChunks'][0][0]) == 2
+    assert int(outs['NumLabelChunks'][0][0]) == 2
+    np.testing.assert_allclose(float(outs['F1-Score'][0][0]), 0.5, atol=1e-5)
+
+
+def test_positive_negative_pair():
+    score = np.array([0.9, 0.1, 0.8, 0.2], dtype='float32')
+    label = np.array([1, 0, 0, 1], dtype='float32')
+    qid = np.array([0, 0, 1, 1], dtype='int64')
+    outs = run_op('positive_negative_pair',
+                  {'Score': score, 'Label': label, 'QueryID': qid})
+    # q0: (0,1) label 1>0, score .9>.1 → positive
+    # q1: (3,2) label 1>0, score .2<.8 → negative
+    assert float(outs['PositivePair'][0][0]) == 1.0
+    assert float(outs['NegativePair'][0][0]) == 1.0

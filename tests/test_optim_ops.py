@@ -1,0 +1,180 @@
+"""Optimizer update-op tests vs numpy update rules.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{sgd,momentum,adam,
+adamax,adagrad,decayed_adagrad,adadelta,rmsprop,ftrl,proximal_gd,
+proximal_adagrad}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(11)
+P = rng.randn(4, 3).astype('float32')
+G = rng.randn(4, 3).astype('float32')
+LR = np.array([0.1], dtype='float32')
+
+
+def _get(outs, slot):
+    return np.asarray(outs[slot][0])
+
+
+def test_sgd():
+    outs = run_op('sgd', {'Param': P, 'Grad': G, 'LearningRate': LR})
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), P - 0.1 * G,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_momentum():
+    v = rng.randn(4, 3).astype('float32')
+    outs = run_op('momentum', {'Param': P, 'Grad': G, 'Velocity': v,
+                               'LearningRate': LR}, {'mu': 0.9})
+    v_new = 0.9 * v + G
+    np.testing.assert_allclose(_get(outs, 'VelocityOut'), v_new,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), P - 0.1 * v_new,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_momentum_nesterov():
+    v = rng.randn(4, 3).astype('float32')
+    outs = run_op('momentum', {'Param': P, 'Grad': G, 'Velocity': v,
+                               'LearningRate': LR},
+                  {'mu': 0.9, 'use_nesterov': True})
+    v_new = 0.9 * v + G
+    np.testing.assert_allclose(_get(outs, 'ParamOut'),
+                               P - (G + 0.9 * v_new) * 0.1,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adam():
+    m = rng.randn(4, 3).astype('float32')
+    v = np.abs(rng.randn(4, 3)).astype('float32')
+    outs = run_op('adam', {'Param': P, 'Grad': G, 'Moment1': m, 'Moment2': v,
+                           'LearningRate': LR,
+                           'Beta1Pow': np.array([0.9], 'float32'),
+                           'Beta2Pow': np.array([0.999], 'float32')},
+                  {'beta1': 0.9, 'beta2': 0.999, 'epsilon': 1e-8})
+    m_new = 0.9 * m + 0.1 * G
+    v_new = 0.999 * v + 0.001 * G * G
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    want = P - lr_t * m_new / (np.sqrt(v_new) + 1e-8)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adamax():
+    m = rng.randn(4, 3).astype('float32')
+    u = np.abs(rng.randn(4, 3)).astype('float32')
+    outs = run_op('adamax', {'Param': P, 'Grad': G, 'Moment': m,
+                             'InfNorm': u, 'LearningRate': LR,
+                             'Beta1Pow': np.array([0.9], 'float32')},
+                  {'beta1': 0.9, 'beta2': 0.999, 'epsilon': 1e-8})
+    m_new = 0.9 * m + 0.1 * G
+    u_new = np.maximum(0.999 * u, np.abs(G))
+    want = P - (0.1 / (1 - 0.9)) * m_new / (u_new + 1e-8)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adagrad():
+    mom = np.abs(rng.randn(4, 3)).astype('float32')
+    outs = run_op('adagrad', {'Param': P, 'Grad': G, 'Moment': mom,
+                              'LearningRate': LR}, {'epsilon': 1e-6})
+    mom_new = mom + G * G
+    want = P - 0.1 * G / (np.sqrt(mom_new) + 1e-6)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_decayed_adagrad():
+    mom = np.abs(rng.randn(4, 3)).astype('float32')
+    outs = run_op('decayed_adagrad',
+                  {'Param': P, 'Grad': G, 'Moment': mom,
+                   'LearningRate': LR}, {'decay': 0.95, 'epsilon': 1e-6})
+    mom_new = 0.95 * mom + 0.05 * G * G
+    want = P - 0.1 * G / (np.sqrt(mom_new) + 1e-6)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adadelta():
+    asg = np.abs(rng.randn(4, 3)).astype('float32')
+    asu = np.abs(rng.randn(4, 3)).astype('float32')
+    outs = run_op('adadelta',
+                  {'Param': P, 'Grad': G, 'AvgSquaredGrad': asg,
+                   'AvgSquaredUpdate': asu}, {'rho': 0.95, 'epsilon': 1e-6})
+    asg_new = 0.95 * asg + 0.05 * G * G
+    update = -np.sqrt((asu + 1e-6) / (asg_new + 1e-6)) * G
+    asu_new = 0.95 * asu + 0.05 * update * update
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), P + update,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_get(outs, 'AvgSquaredUpdateOut'), asu_new,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop():
+    ms = np.abs(rng.randn(4, 3)).astype('float32')
+    mom = rng.randn(4, 3).astype('float32')
+    outs = run_op('rmsprop', {'Param': P, 'Grad': G, 'MeanSquare': ms,
+                              'Moment': mom, 'LearningRate': LR},
+                  {'decay': 0.9, 'momentum': 0.5, 'epsilon': 1e-10})
+    ms_new = 0.9 * ms + 0.1 * G * G
+    mom_new = 0.5 * mom + 0.1 * G / np.sqrt(ms_new + 1e-10)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), P - mom_new,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ftrl():
+    sq = np.abs(rng.randn(4, 3)).astype('float32')
+    lin = rng.randn(4, 3).astype('float32')
+    outs = run_op('ftrl', {'Param': P, 'Grad': G, 'SquaredAccumulator': sq,
+                           'LinearAccumulator': lin, 'LearningRate': LR},
+                  {'l1': 0.1, 'l2': 0.2, 'lr_power': -0.5})
+    new_sq = sq + G * G
+    sigma = (new_sq ** 0.5 - sq ** 0.5) / 0.1
+    new_lin = lin + G - sigma * P
+    x = np.clip(new_lin, -0.1, 0.1) - new_lin
+    y = new_sq ** 0.5 / 0.1 + 2 * 0.2
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), x / y,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_proximal_gd():
+    outs = run_op('proximal_gd', {'Param': P, 'Grad': G,
+                                  'LearningRate': LR},
+                  {'l1': 0.05, 'l2': 0.1})
+    prox = P - 0.1 * G
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.05, 0.0) / \
+        (1.0 + 0.1 * 0.1)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_proximal_adagrad():
+    mom = np.abs(rng.randn(4, 3)).astype('float32')
+    outs = run_op('proximal_adagrad',
+                  {'Param': P, 'Grad': G, 'Moment': mom,
+                   'LearningRate': LR}, {'l1': 0.05, 'l2': 0.1})
+    mom_new = mom + G * G
+    lr_t = 0.1 / np.sqrt(mom_new)
+    prox = P - lr_t * G
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr_t * 0.05, 0.0) / \
+        (1.0 + lr_t * 0.1)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_sparse_grad_tuple():
+    """Sparse (rows, values) grads scatter-add into the dense update —
+    parity with lookup_table_op.cc SelectedRows grads + sgd_op sparse
+    branch."""
+    param = rng.randn(10, 4).astype('float32')
+    rows = np.array([2, 7, 2], dtype='int32')
+    vals = rng.randn(3, 4).astype('float32')
+    outs = run_op('sgd', {'Param': param,
+                          'Grad': [(rows, vals)],
+                          'LearningRate': LR})
+    dense = np.zeros_like(param)
+    np.add.at(dense, rows, vals)
+    np.testing.assert_allclose(_get(outs, 'ParamOut'), param - 0.1 * dense,
+                               rtol=1e-4, atol=1e-5)
