@@ -48,6 +48,14 @@ dataset = datasets  # parity alias: paddle.v2.dataset
 from . import parallel
 from . import distributed
 from .distributed import DistributeTranspiler, SimpleDistributeTranspiler
+from . import highlevel  # v2 trainer/event/parameters/inference (V5-V7)
+from . import flags  # A5 env-var config registry
+from .flags import FLAGS
+from . import debug  # A3 nan/inf guards
+from . import transpiler  # P14 memory_optimize -> remat
+from .transpiler import memory_optimize, release_memory
+from . import utils  # P17 net_drawer
+from . import adversarial  # M12 FGSM toolkit
 
 Tensor = LoDTensor
 

@@ -1,0 +1,14 @@
+"""M12 — adversarial examples toolkit (advbox parity).
+
+Reference parity: /root/reference/adversarial/advbox — Model wrapper over a
+program (predict/gradient via the executor) + gradient-sign attacks.  The
+reference fetches d(loss)/d(input) through append_backward on the input
+var; here that is the same `calc_gradient`-style autodiff, one fused XLA
+program per (predict, gradient) call.
+"""
+from .model import PaddleModel, TPUModel
+from .attacks import Attack, FGSM, GradientSignAttack, IFGSM, \
+    IteratorGradientSignAttack
+
+__all__ = ['PaddleModel', 'TPUModel', 'Attack', 'FGSM',
+           'GradientSignAttack', 'IFGSM', 'IteratorGradientSignAttack']

@@ -1,0 +1,60 @@
+"""Gradient-sign attacks.
+
+Reference parity: adversarial/advbox/attacks/{base,gradientsign}.py —
+FGSM (Goodfellow et al. 2015) sweeps epsilon until the predicted label
+flips; the iterative variant takes repeated small sign steps.
+"""
+import numpy as np
+
+__all__ = ['Attack', 'GradientSignAttack', 'FGSM',
+           'IteratorGradientSignAttack', 'IFGSM']
+
+
+class Attack(object):
+    """Base class: subclasses implement _apply(image, label)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def __call__(self, image, label, **kwargs):
+        return self._apply(np.asarray(image, np.float32),
+                           np.asarray(label, np.int64), **kwargs)
+
+
+class GradientSignAttack(Attack):
+    """FGSM: x' = clip(x + eps * sign(d loss/d x)); returns the first
+    adversarial image along an epsilon sweep, or None."""
+
+    def _apply(self, image, label, epsilons=100):
+        if np.isscalar(epsilons):
+            epsilons = np.linspace(0, 1, num=int(epsilons) + 1)[1:]
+        lo, hi = self.model.bounds()
+        pre_label = np.argmax(self.model.predict(image, label), axis=-1)
+        grad_sign = np.sign(self.model.gradient(image, label)) * (hi - lo)
+        for eps in epsilons:
+            adv = np.clip(image + eps * grad_sign, lo, hi)
+            adv_label = np.argmax(self.model.predict(adv, label), axis=-1)
+            if np.any(adv_label != pre_label):
+                return adv
+        return None
+
+
+class IteratorGradientSignAttack(Attack):
+    """I-FGSM: `steps` sign steps of size epsilon, re-evaluating the
+    gradient each step."""
+
+    def _apply(self, image, label, epsilon=0.01, steps=10):
+        lo, hi = self.model.bounds()
+        pre_label = np.argmax(self.model.predict(image, label), axis=-1)
+        adv = image.copy()
+        for _ in range(int(steps)):
+            grad = self.model.gradient(adv, label)
+            adv = np.clip(adv + epsilon * np.sign(grad) * (hi - lo), lo, hi)
+            adv_label = np.argmax(self.model.predict(adv, label), axis=-1)
+            if np.any(adv_label != pre_label):
+                return adv
+        return None
+
+
+FGSM = GradientSignAttack
+IFGSM = IteratorGradientSignAttack

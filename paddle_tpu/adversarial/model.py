@@ -1,0 +1,74 @@
+"""Model wrapper exposing predict() and gradient() for attacks.
+
+Reference parity: adversarial/advbox/models/{base,paddle}.py — PaddleModel
+wires append_backward(parameter_list=[input]) and fetches the input grad;
+here the same program-level autodiff produces `input@GRAD` via
+calc_gradient (one fused forward+backward XLA computation).
+"""
+import numpy as np
+
+from ..core.backward import calc_gradient
+from ..core.executor import Executor
+from ..core.place import CPUPlace
+from ..core.program import grad_var_name
+
+__all__ = ['TPUModel', 'PaddleModel']
+
+
+class TPUModel(object):
+    """Create a model wrapper for adversarial attacks.
+
+    Args:
+        program: the Program holding the forward + loss graph.
+        input_name: name of the image input var.
+        label_name: name of the label input var.
+        predict_name: name of the softmax/probability output var.
+        cost_name: name of the scalar loss var.
+        bounds: (min, max) valid pixel range.
+    """
+
+    def __init__(self, program, input_name, label_name, predict_name,
+                 cost_name, bounds=(0.0, 1.0), place=None):
+        self._program = program
+        self._input_name = input_name
+        self._label_name = label_name
+        self._predict_name = predict_name
+        self._cost_name = cost_name
+        self._bounds = tuple(bounds)
+        self._exe = Executor(place or CPUPlace())
+
+        block = program.global_block()
+        gname = grad_var_name(input_name)
+        if not block.has_var(gname):
+            loss = block.var(cost_name)
+            calc_gradient(loss, [block.var(input_name)])
+        self._gradient_name = gname
+
+    def bounds(self):
+        return self._bounds
+
+    def num_classes(self):
+        return self._program.global_block().var(self._predict_name).shape[-1]
+
+    def predict(self, image, label=None):
+        """Probabilities [N, C] for a [N, ...] image batch."""
+        image = np.asarray(image, dtype=np.float32)
+        feed = {self._input_name: image}
+        if label is not None:
+            feed[self._label_name] = np.asarray(label, np.int64)
+        else:
+            feed[self._label_name] = np.zeros((image.shape[0], 1), np.int64)
+        p, = self._exe.run(self._program, feed=feed,
+                           fetch_list=[self._predict_name])
+        return np.asarray(p)
+
+    def gradient(self, image, label):
+        """d(loss)/d(image), same shape as image."""
+        feed = {self._input_name: np.asarray(image, np.float32),
+                self._label_name: np.asarray(label, np.int64)}
+        g, = self._exe.run(self._program, feed=feed,
+                           fetch_list=[self._gradient_name])
+        return np.asarray(g)
+
+
+PaddleModel = TPUModel  # advbox name parity

@@ -130,6 +130,11 @@ def set_gradient_clip(clip, param_list=None, program=None):
         _gradient_clip_attr = clip
 
 
+def current_gradient_clip():
+    """The program-wide clip set via set_gradient_clip (or None)."""
+    return _gradient_clip_attr
+
+
 def append_gradient_clip_ops(param_grad):
     context = {}
     create_op_callbacks = []

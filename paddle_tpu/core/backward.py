@@ -25,16 +25,70 @@ def _collect_trainable_params(block, loss, parameter_list=None,
     return [n for n in names if n not in no_grad]
 
 
+def _find_sparse_params(block, param_names):
+    """Params eligible for the SelectedRows grad path: every op reading
+    the param is a GLOBAL-block lookup_table with is_sparse=True (parity:
+    lookup_table_op.cc SelectedRows grad applies per-table).  Params with
+    a regularizer or gradient clip fall back to dense — those append
+    elementwise ops over the grad var, which must stay an array.  Returns
+    {param_name: (height, padding_idx, [(ids_name, out_name), ...])}."""
+    from ..clip import current_gradient_clip
+    lookups = {}  # wname -> (padding_idx set, [(ids, out)])
+    readers = {}  # var name -> [ops reading it, any block]
+    global_ops = set()
+    for b in block.program.blocks:
+        for op in b.ops:
+            for n in op.input_arg_names:
+                readers.setdefault(n, []).append(op)
+            if b is block:
+                global_ops.add(id(op))
+    sparse = {}
+    for b in block.program.blocks:
+        for op in b.ops:
+            if op.type == 'lookup_table' and op.attrs.get('is_sparse'):
+                w = op.inputs['W'][0]
+                pads, pairs = lookups.setdefault(w, (set(), []))
+                pads.add(op.attrs.get('padding_idx', None))
+                pairs.append((op.inputs['Ids'][0], op.outputs['Out'][0],
+                              id(op)))
+    for pn in param_names:
+        if pn not in lookups:
+            continue
+        if any(op.type != 'lookup_table' or not op.attrs.get('is_sparse')
+               for op in readers.get(pn, [])):
+            continue  # param also read densely — keep the dense grad
+        pads, pairs = lookups[pn]
+        if any(oid not in global_ops for _, _, oid in pairs):
+            continue  # lookup inside a sub-block: dense fallback
+        if len(pads) != 1:
+            continue  # conflicting padding_idx across lookups: play safe
+        p = block.var(pn)
+        if getattr(p, 'regularizer', None) is not None or \
+                getattr(p, 'gradient_clip_attr', None) is not None or \
+                current_gradient_clip() is not None:
+            continue  # clip/regularizer ops need a dense grad array
+        sparse[pn] = (p.shape[0], next(iter(pads)),
+                      [(ids, out) for ids, out, _ in pairs])
+    return sparse
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """Append an `autodiff` op producing `<param>@GRAD` for every trainable
     parameter, and return [(param, grad_var)] like fluid's append_backward.
+
+    Params only read by `is_sparse` lookup_table ops take the SelectedRows
+    path: the autodiff differentiates w.r.t. the lookup *outputs* and a
+    `sparse_grad_assemble` op packs (ids, out-grads) into a SelectedRows —
+    the vocab-height dense grad never exists (reference
+    lookup_table_op.cc:52 + sgd_op.cc sparse branch).
     """
     assert isinstance(loss, Variable)
     program = loss.block.program
     block = program.global_block()
     param_names = _collect_trainable_params(block, loss, parameter_list,
                                             no_grad_set)
+    sparse = _find_sparse_params(block, param_names)
 
     grad_names = [grad_var_name(n) for n in param_names]
     params_and_grads = []
@@ -48,17 +102,46 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             g = block.var(gn)
         params_and_grads.append((p, g))
 
+    # autodiff diff-targets: dense params as-is; sparse params swap in
+    # their lookup-output vars (deduped, program order)
+    ad_params, ad_grads = [], []
+    for pn in param_names:
+        if pn in sparse:
+            for _ids, out_name in sparse[pn][2]:
+                if out_name not in ad_params:
+                    ad_params.append(out_name)
+                    ad_grads.append(grad_var_name(out_name))
+        else:
+            ad_params.append(pn)
+            ad_grads.append(grad_var_name(pn))
+    for n, gn in zip(ad_params, ad_grads):
+        if not block.has_var(gn):
+            v = block.var(n)
+            g = block.create_var(name=gn, shape=v.shape, dtype=v.dtype,
+                                 persistable=False)
+            g.stop_gradient = True
+
     block.append_op(
         type='autodiff',
         inputs={'Loss': [loss]},
-        outputs={'Grads': grad_names},
+        outputs={'Grads': ad_grads},
         attrs={
             'loss_name': loss.name,
-            'param_names': param_names,
-            'grad_names': grad_names,
+            'param_names': ad_params,
+            'grad_names': ad_grads,
             'loss_scale': 1.0,
             'op_role': 'backward',
         })
+    for pn, (height, pad, pairs) in sparse.items():
+        attrs = {'height': height, 'op_role': 'backward'}
+        if pad is not None:
+            attrs['padding_idx'] = pad
+        block.append_op(
+            type='sparse_grad_assemble',
+            inputs={'Ids': [ids for ids, _ in pairs],
+                    'OutGrad': [grad_var_name(o) for _, o in pairs]},
+            outputs={'Out': [grad_var_name(pn)]},
+            attrs=attrs)
     # Note: fluid's error_clip is applied here via callbacks weaving clip ops
     # into the grad-op chain.  In this framework a var's `error_clip` is read
     # directly by the executor, which wraps the var's forward value in a

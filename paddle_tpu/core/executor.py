@@ -247,6 +247,12 @@ def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
         loss = jnp.sum(loss.astype(jnp.float32)) * loss_scale
         return loss, env2
 
+    from ..transpiler.memory_optimize import get_remat_policy
+    remat = get_remat_policy(ctx.program)
+    if remat is not None:
+        # P14 memory_optimize: backward recomputes activations instead of
+        # keeping them live across the fused fwd+bwd
+        f = remat(f)
     (_, env_fwd), grads = jax.value_and_grad(f, has_aux=True)(params)
     if publish:
         for n in written:

@@ -1,0 +1,67 @@
+"""SelectedRows: sparse row-set gradients (C5/O11).
+
+Reference parity: paddle/framework/selected_rows.{h,cc} — a (rows, value)
+pair standing in for a mostly-zero dense tensor, produced by
+lookup_table's grad and consumed by the sparse branches of
+sgd/adagrad/adam (paddle/operators/sgd_op.cc, adagrad_op.cc).
+
+TPU-native design: a registered pytree of (rows int32 [K], values [K, D])
+with a static `height` (the dense row count), so a SelectedRows can flow
+through a jitted step like any array.  K is static (= number of looked-up
+ids per step), which is exactly the TPU-friendly property: the *dense*
+vocab-height grad never materializes; optimizers scatter row updates into
+the donated parameter buffer in place.
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ['SelectedRows', 'merge_duplicate_rows']
+
+
+class SelectedRows(object):
+    """rows: int32 [K] dense-row indices (may repeat); values: [K, ...]
+    per-row data; height: static dense row count."""
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def to_dense(self):
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def __repr__(self):
+        return 'SelectedRows(rows=%s, values=%s, height=%d)' % (
+            self.rows.shape, self.values.shape, self.height)
+
+
+jax.tree_util.register_pytree_node(
+    SelectedRows,
+    lambda s: ((s.rows, s.values), s.height),
+    lambda height, ch: SelectedRows(ch[0], ch[1], height))
+
+
+def merge_duplicate_rows(rows, values):
+    """Sum values of duplicate rows (reference
+    operators/math/selected_rows_functor MergeAdd) with static shapes:
+    sort by row, segment-sum runs of equal rows.  Returns (rows', values')
+    of the SAME length K — unused tail slots point at row0 with zero
+    values, so scatter-consumers can apply them as harmless no-ops ONLY
+    when the per-row update of a zero gradient is zero (sgd/adagrad-style
+    g-scaled updates).  Callers needing true no-ops must mask on
+    `valid` = slot < number of unique rows (third return value)."""
+    rows = rows.astype(jnp.int32).reshape(-1)
+    k = rows.shape[0]
+    order = jnp.argsort(rows)
+    srows = rows[order]
+    svals = values[order]
+    is_new = jnp.concatenate([jnp.ones((1,), bool),
+                              srows[1:] != srows[:-1]])
+    seg = jnp.cumsum(is_new) - 1  # [K] segment id per sorted slot
+    merged_vals = jax.ops.segment_sum(svals, seg, num_segments=k)
+    merged_rows = jnp.zeros((k,), jnp.int32).at[seg].set(srows)
+    n_unique = seg[-1] + 1
+    valid = jnp.arange(k) < n_unique
+    return merged_rows, merged_vals, valid

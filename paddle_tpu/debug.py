@@ -1,0 +1,62 @@
+"""A3 — failure detection: nan/inf guards.
+
+Reference parity: paddle/framework/executor.cc `check_nan_inf` (per-op
+output scan under FLAGS_check_nan_inf) and the fluid debugger.  TPU-native
+design: `jax.debug_nans` makes XLA itself fault on the first NaN-producing
+op inside the fused step (strictly stronger than the reference's per-op
+host scan), plus host-side finite checks on fetched values.
+"""
+import contextlib
+
+import numpy as np
+
+import jax
+
+from .flags import FLAGS
+
+__all__ = ['has_nan_inf', 'check_nan_inf', 'nan_guard', 'guarded_fetches']
+
+
+def has_nan_inf(value):
+    """True if the array holds any NaN or Inf."""
+    arr = np.asarray(value)
+    if arr.dtype.kind not in 'fc':
+        return False
+    return bool(np.any(~np.isfinite(arr)))
+
+
+def check_nan_inf(value, name='<tensor>'):
+    """Raise RuntimeError if `value` has NaN/Inf (executor.cc parity:
+    `PADDLE_ENFORCE(!framework::HasInvalidValue(...))`)."""
+    if has_nan_inf(value):
+        arr = np.asarray(value)
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        raise RuntimeError(
+            "Tensor %s has %d NaN and %d Inf values" % (name, n_nan, n_inf))
+    return value
+
+
+def guarded_fetches(fetches, names=None):
+    """Check every fetched value; returns fetches unchanged when clean."""
+    for i, v in enumerate(fetches):
+        check_nan_inf(v, names[i] if names else 'fetch[%d]' % i)
+    return fetches
+
+
+@contextlib.contextmanager
+def nan_guard():
+    """Enable jax.debug_nans for the enclosed region: the first op that
+    produces a NaN raises immediately with the offending primitive —
+    device-side failure detection the reference scans for on host."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update('jax_debug_nans', True)
+    try:
+        yield
+    finally:
+        jax.config.update('jax_debug_nans', prev)
+
+
+if FLAGS.check_nan_inf:
+    # gflags parity: PADDLE_TPU_CHECK_NAN_INF=1 arms debug_nans globally
+    jax.config.update('jax_debug_nans', True)

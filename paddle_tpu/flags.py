@@ -1,0 +1,73 @@
+"""A5 — env-var configuration registry (gflags parity).
+
+Reference parity: gflags definitions scattered through the C++ core
+(FLAGS_check_nan_inf, FLAGS_fraction_of_gpu_memory_to_use, ...) set via
+environment.  Here every flag is `PADDLE_TPU_<NAME>` in the environment,
+declared with a type and default, and read through the global `FLAGS`.
+"""
+import os
+
+__all__ = ['FLAGS', 'DEFINE_bool', 'DEFINE_int', 'DEFINE_float',
+           'DEFINE_string']
+
+_TRUE = ('1', 'true', 'yes', 'on')
+
+
+class _Flags(object):
+    def __init__(self):
+        self._defs = {}
+
+    def _define(self, name, default, parser, help_str):
+        self._defs[name] = (default, parser, help_str)
+
+    def __getattr__(self, name):
+        defs = object.__getattribute__(self, '_defs')
+        if name not in defs:
+            raise AttributeError("flag %r was never defined" % name)
+        default, parser, _ = defs[name]
+        env = os.environ.get('PADDLE_TPU_' + name.upper())
+        if env is None:
+            return default
+        return parser(env)
+
+    def declared(self):
+        return {n: getattr(self, n) for n in self._defs}
+
+    def help(self):
+        return '\n'.join(
+            'PADDLE_TPU_%s (default %r): %s' % (n.upper(), d, h)
+            for n, (d, _, h) in sorted(self._defs.items()))
+
+
+FLAGS = _Flags()
+
+
+def DEFINE_bool(name, default, help_str=''):
+    FLAGS._define(name, default, lambda s: s.lower() in _TRUE, help_str)
+
+
+def DEFINE_int(name, default, help_str=''):
+    FLAGS._define(name, default, int, help_str)
+
+
+def DEFINE_float(name, default, help_str=''):
+    FLAGS._define(name, default, float, help_str)
+
+
+def DEFINE_string(name, default, help_str=''):
+    FLAGS._define(name, default, str, help_str)
+
+
+# -- core flags (reference gflags counterparts) ---------------------------
+DEFINE_bool('check_nan_inf', False,
+            'arm jax_debug_nans: fault on the first NaN-producing op '
+            '(FLAGS_check_nan_inf)')
+DEFINE_bool('synth_data', True,
+            'datasets serve deterministic synthetic samples (zero-egress '
+            'environments)')
+DEFINE_int('reader_buf_size', 64,
+           'prefetch depth for buffered/xmap readers')
+DEFINE_string('profile_dir', '/tmp/paddle_tpu_prof',
+              'where profiler traces are written')
+DEFINE_bool('use_native_runtime', True,
+            'use the C++ dataio prefetcher when the extension builds')

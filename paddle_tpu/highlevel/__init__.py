@@ -1,0 +1,15 @@
+"""V5–V7 — the v2 high-level API (trainer/event/parameters/inference)
+over the Fluid executor.
+
+Reference parity: python/paddle/v2/{trainer,event,parameters,inference}.py
+— the v2 user surface (`paddle.parameters.create`, `trainer.SGD(...).train
+(reader, event_handler)`, `paddle.infer`) running on the TPU-native core.
+"""
+from . import event
+from . import parameters
+from .inference import Inference, infer
+from .trainer import SGD
+
+__all__ = ['event', 'parameters', 'trainer', 'SGD', 'Inference', 'infer']
+
+from . import trainer  # noqa: E402

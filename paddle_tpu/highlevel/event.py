@@ -1,0 +1,54 @@
+"""V5 — v2 training events.
+
+Reference parity: python/paddle/v2/event.py (BeginPass/EndPass/
+BeginIteration/EndIteration/EndForwardBackward/TestResult).  The reference
+carries a swig Evaluator; here `metrics` is a plain dict filled from the
+trainer's fetches.
+"""
+
+__all__ = ['EndIteration', 'BeginIteration', 'BeginPass', 'EndPass',
+           'TestResult', 'EndForwardBackward']
+
+
+class WithMetric(object):
+    def __init__(self, metrics=None):
+        self.metrics = dict(metrics or {})
+
+
+class TestResult(WithMetric):
+    """Result of trainer.test()."""
+
+    def __init__(self, cost, metrics=None):
+        super(TestResult, self).__init__(metrics)
+        self.cost = cost
+
+
+class BeginPass(object):
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, metrics=None):
+        super(EndPass, self).__init__(metrics)
+        self.pass_id = pass_id
+
+
+class BeginIteration(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndForwardBackward(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        super(EndIteration, self).__init__(metrics)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost

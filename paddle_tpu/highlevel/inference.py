@@ -1,0 +1,65 @@
+"""V6 — v2 inference: paddle.infer over the Fluid executor.
+
+Reference parity: python/paddle/v2/inference.py (Inference.iter_infer /
+infer with field selection).  The output program is the pruned
+inference_optimize'd slice ending at `output_layer`.
+"""
+import numpy as np
+
+from .parameters import Parameters
+from ..core.executor import Executor
+from ..core.place import default_place
+from ..data_feeder import DataFeeder
+
+__all__ = ['Inference', 'infer']
+
+
+class Inference(object):
+    def __init__(self, output_layer, parameters):
+        outputs = (output_layer if isinstance(output_layer, (list, tuple))
+                   else [output_layer])
+        program = outputs[0].block.program
+        self.__outputs__ = outputs
+        self.__program__ = program.prune(
+            targets=list(outputs)).inference_optimize()
+        self.__parameters__ = parameters
+        self.__exe__ = Executor(default_place())
+
+    def _feed_vars(self, feeding):
+        block = self.__program__.global_block()
+        # prune() drops ops but keeps var declarations: only data vars some
+        # surviving op actually reads are real inputs
+        read = set()
+        for b in self.__program__.blocks:
+            for op in b.ops:
+                read.update(op.input_arg_names)
+        data_vars = [v for v in block.vars.values()
+                     if getattr(v, 'is_data', False) and v.name in read]
+        if feeding is None:
+            return data_vars
+        order = sorted(feeding, key=lambda k: feeding[k])
+        return [block.var(n) for n in order]
+
+    def iter_infer_field(self, field, input, feeding=None, batch_size=None):
+        assert field == 'value', "only the 'value' field is supported"
+        feeder = DataFeeder(place=self.__exe__.place,
+                            feed_list=self._feed_vars(feeding))
+        bs = batch_size or len(input)
+        for i in range(0, len(input), bs):
+            outs = self.__exe__.run(
+                self.__program__, feed=feeder.feed(input[i:i + bs]),
+                fetch_list=[o.name for o in self.__outputs__])
+            yield [np.asarray(o) for o in outs]
+
+    def infer(self, input, field='value', feeding=None, batch_size=None):
+        parts = list(self.iter_infer_field(field, input, feeding,
+                                           batch_size))
+        joined = [np.concatenate([p[i] for p in parts], axis=0)
+                  for i in range(len(self.__outputs__))]
+        return joined[0] if len(joined) == 1 else joined
+
+
+def infer(output_layer, parameters, input, feeding=None, field='value'):
+    """One-shot inference (reference paddle.infer)."""
+    return Inference(output_layer, parameters).infer(
+        input, field=field, feeding=feeding)

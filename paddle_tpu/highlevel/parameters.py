@@ -1,0 +1,87 @@
+"""V7 — v2 Parameters: a dict-like view over the trained parameter values.
+
+Reference parity: python/paddle/v2/parameters.py (create/keys/get/set/
+to_tar/from_tar over the GradientMachine).  Here the backing store is the
+fluid Scope: `create(cost)` runs the startup program once, then get/set
+read/write device arrays by parameter name.
+"""
+import pickle
+
+import numpy as np
+
+from ..core.program import default_startup_program
+from ..core.scope import global_scope
+
+__all__ = ['Parameters', 'create']
+
+
+class Parameters(object):
+    def __init__(self, program, scope=None):
+        self._program = program
+        self._scope = scope or global_scope()
+
+    # -- dict-like ------------------------------------------------------
+    def names(self):
+        return [p.name for p in self._program.global_block()
+                .all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, key):
+        return key in self.names()
+
+    def __contains__(self, key):
+        return self.has_key(key)
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __len__(self):
+        return len(self.names())
+
+    def get(self, parameter_name):
+        v = self._scope.find_var(parameter_name)
+        if v is None:
+            raise ValueError("parameter %r has no value; run the trainer "
+                             "or set() it first" % parameter_name)
+        return np.asarray(v)
+
+    def __getitem__(self, key):
+        return self.get(key)
+
+    def set(self, parameter_name, value):
+        self._scope.set(parameter_name, np.asarray(value))
+
+    def __setitem__(self, key, value):
+        self.set(key, value)
+
+    def get_shape(self, key):
+        return tuple(self._program.global_block().var(key).shape)
+
+    # -- serialization (reference to_tar/from_tar -> pickle dict) -------
+    def to_tar(self, f):
+        pickle.dump({n: self.get(n) for n in self.names()}, f, protocol=2)
+
+    def from_tar(self, f):
+        data = pickle.load(f)
+        for n, v in data.items():
+            self.set(n, v)
+        return self
+
+    @staticmethod
+    def load(f):
+        """Pair of from_tar for a fresh Parameters with no program: returns
+        the raw {name: array} dict."""
+        return pickle.load(f)
+
+
+def create(cost, startup_program=None):
+    """Materialize parameters for the program that produced `cost` by
+    running the startup program (reference: parameters.create(topology))."""
+    from ..core.executor import Executor
+    from ..core.place import default_place
+    program = cost.block.program
+    exe = Executor(default_place())
+    exe.run(startup_program or default_startup_program())
+    return Parameters(program)

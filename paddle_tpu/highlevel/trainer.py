@@ -1,0 +1,136 @@
+"""V5 — v2 trainer: the SGD event-loop API over the Fluid executor.
+
+Reference parity: python/paddle/v2/trainer.py:86 (SGD.train) — reader +
+topology + update rule in one object, firing BeginPass/BeginIteration/
+EndIteration/EndPass events.  The reference drives the legacy C++
+GradientMachine; here the same surface drives the one-HLO-per-step
+Executor, so a v2-style script runs unchanged on TPU.
+"""
+import numpy as np
+
+from . import event as v2_event
+from .parameters import Parameters
+from ..core.executor import Executor
+from ..core.place import default_place
+from ..core.program import default_startup_program
+from ..data_feeder import DataFeeder
+from ..optimizer import Optimizer
+
+__all__ = ['SGD']
+
+
+def default_event_handler(event):
+    pass
+
+
+class SGD(object):
+    """Trainer: combines cost, Parameters and an optimizer.
+
+    :param cost: fluid loss Variable (the topology's target).
+    :param parameters: highlevel.parameters.Parameters (from
+        parameters.create(cost)).
+    :param update_equation: a fluid optimizer (SGDOptimizer, Adam...).
+    :param extra_layers: extra fetch targets kept alive in the program
+        (parity with reference extra_layers).
+    """
+
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, metrics=None):
+        if not isinstance(parameters, Parameters):
+            raise TypeError('parameters should be '
+                            'highlevel.parameters.Parameters')
+        if not isinstance(update_equation, Optimizer):
+            raise TypeError('update equation parameter must be a fluid '
+                            'optimizer')
+        self.__cost__ = cost
+        self.__parameters__ = parameters
+        self.__program__ = cost.block.program
+        self.__metrics__ = dict(metrics or {})  # name -> Variable
+        # clone the forward-only program BEFORE optimizer ops are woven in
+        self.__test_program__ = self.__program__.clone(for_test=True)
+        update_equation.minimize(cost)
+        self.__exe__ = Executor(default_place())
+        self._startup_catchup()
+
+    def _startup_catchup(self):
+        """Run startup ops whose outputs have no value yet (optimizer
+        accumulators added after parameters.create ran startup); params the
+        user already set stay untouched."""
+        from ..core.scope import global_scope
+        startup = default_startup_program()
+        scope = global_scope()
+        missing = [v.name for v in startup.list_vars()
+                   if v.persistable and not scope.has(v.name)]
+        if missing:
+            self.__exe__.run(startup.prune(targets=missing))
+
+    def _feeder(self, feeding, data_batch):
+        feed_vars = self._feed_vars(feeding, data_batch)
+        feeder = DataFeeder(place=self.__exe__.place, feed_list=feed_vars)
+        return feeder
+
+    def _feed_vars(self, feeding, data_batch):
+        block = self.__program__.global_block()
+        data_vars = [v for v in block.iter_vars()] if hasattr(
+            block, 'iter_vars') else list(block.vars.values())
+        data_vars = [v for v in data_vars if getattr(v, 'is_data', False)]
+        if feeding is None:
+            return data_vars  # program declaration order
+        order = sorted(feeding, key=lambda k: feeding[k])
+        return [block.var(n) for n in order]
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """The reference SGD.train event loop (trainer.py:155)."""
+        if event_handler is None:
+            event_handler = default_event_handler
+        fetch = [self.__cost__] + list(self.__metrics__.values())
+        names = list(self.__metrics__.keys())
+        feeder = None
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_costs = []
+            pass_metrics = {n: [] for n in names}
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                if feeder is None:
+                    feeder = self._feeder(feeding, data_batch)
+                outs = self.__exe__.run(self.__program__,
+                                        feed=feeder.feed(data_batch),
+                                        fetch_list=fetch)
+                event_handler(v2_event.EndForwardBackward(pass_id,
+                                                          batch_id))
+                cost = float(np.ravel(outs[0])[0])
+                metrics = {n: float(np.ravel(v)[0])
+                           for n, v in zip(names, outs[1:])}
+                pass_costs.append(cost)
+                for n, v in metrics.items():
+                    pass_metrics[n].append(v)
+                event_handler(v2_event.EndIteration(pass_id, batch_id,
+                                                    cost, metrics))
+            event_handler(v2_event.EndPass(
+                pass_id, {n: float(np.mean(v)) if v else 0.0
+                          for n, v in pass_metrics.items()}))
+
+    def test(self, reader, feeding=None):
+        """Average cost/metrics over the reader on the for_test program."""
+        fetch_names = [self.__cost__.name] + [
+            v.name for v in self.__metrics__.values()]
+        names = list(self.__metrics__.keys())
+        feeder = None
+        costs, metrics = [], {n: [] for n in names}
+        for data_batch in reader():
+            if feeder is None:
+                feeder = self._feeder(feeding, data_batch)
+            outs = self.__exe__.run(self.__test_program__,
+                                    feed=feeder.feed(data_batch),
+                                    fetch_list=fetch_names)
+            costs.append(float(np.ravel(outs[0])[0]))
+            for n, v in zip(names, outs[1:]):
+                metrics[n].append(float(np.ravel(v)[0]))
+        return v2_event.TestResult(
+            float(np.mean(costs)) if costs else 0.0,
+            {n: float(np.mean(v)) if v else 0.0
+             for n, v in metrics.items()})
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__.to_tar(f)

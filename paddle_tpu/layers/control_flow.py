@@ -504,6 +504,12 @@ class ParallelDo(object):
     DataParallel shards it for real."""
 
     def __init__(self, places, name=None):
+        import warnings
+        warnings.warn(
+            "ParallelDo builds its body inline (single-device numerics); "
+            "for real multi-device execution run the program with "
+            "parallel.DataParallel / run_sharded over a Mesh",
+            stacklevel=2)
         self.helper = LayerHelper('parallel_do', name=name)
         self._outputs = []
 

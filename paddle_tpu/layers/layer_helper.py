@@ -127,8 +127,11 @@ class LayerHelper(object):
         return self.main_program.current_block().create_var(*args, **kwargs)
 
     def create_global_variable(self, persistable=False, *args, **kwargs):
+        # NOT is_data: optimizer/evaluator state and LR counters are
+        # internal globals, not feedable inputs (is_data drives feed-var
+        # discovery in the v2 trainer and net_drawer)
         return self.main_program.global_block().create_var(
-            *args, persistable=persistable, is_data=True, **kwargs)
+            *args, persistable=persistable, **kwargs)
 
     def set_variable_initializer(self, var, initializer):
         """Give a non-parameter global var an init op in the startup

@@ -195,9 +195,4 @@ def equal(x, y, cond=None, **kwargs):
     return cond
 
 
-def array_to_lod_tensor(*args, **kwargs):
-    raise NotImplementedError(
-        "tensor-array ops arrive with control-flow support")
-
-
 __all__ += ['select', 'less_than', 'equal']

@@ -4,6 +4,6 @@ Reference parity: paddle/operators/* (one jax function per reference op
 kernel family; see SURVEY.md §2.2).
 """
 from . import (activations, beam_search, common, control_flow, conv, crf,
-               ctc, embedding, loss, math, metrics, misc, norm, optim_ops,
-               pool, random, rnn, sequence, tensor_array,
+               ctc, detection, embedding, loss, math, metrics, misc, norm,
+               optim_ops, pool, random, rnn, sequence, tensor_array,
                tensor_ops)  # noqa: F401

@@ -94,8 +94,10 @@ def crf_viterbi(emission, lengths, transition):
     last = jnp.argmax(delta_T + end[None, :], axis=1)  # [B]
 
     def back(lab, bp_t):
+        # bp_t holds time-t's predecessor pointers; emit the predecessor
+        # (the tag at bp_t's own time step), not the carried-in tag
         prev = jnp.take_along_axis(bp_t, lab[:, None], axis=1)[:, 0]
-        return prev, lab
+        return prev, prev
 
     _, path_rev = jax.lax.scan(back, last, bps, reverse=True)
     path = jnp.concatenate([path_rev, last[None, :]], axis=0)  # [T, B]

@@ -1,0 +1,120 @@
+"""SSD detection ops (O15).
+
+Reference parity: paddle/operators/detection_output_op.{h,cc} — decode
+prior boxes with variances, softmax the class scores, per-class greedy
+NMS, global top-k.  The reference walks std::vector<BBox> per image on the
+host; TPU-native design keeps a dense [N, P] lattice: decode is one fused
+elementwise pass, NMS is a `lax.fori_loop` of vectorized IoU suppression
+(static shapes), and the output is a fixed [N, keep_top_k, 6] tensor with
+label -1 padding instead of a ragged LoD.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+def decode_box(prior, loc):
+    """Center-form decode with variances (reference math::DecodeBBox).
+    prior [P, 8] = (xmin, ymin, xmax, ymax, v0, v1, v2, v3); loc [P, 4]."""
+    p = prior.astype(jnp.float32)
+    pw = p[:, 2] - p[:, 0]
+    ph = p[:, 3] - p[:, 1]
+    pcx = (p[:, 0] + p[:, 2]) * 0.5
+    pcy = (p[:, 1] + p[:, 3]) * 0.5
+    v = p[:, 4:8]
+    l = loc.astype(jnp.float32)
+    cx = v[:, 0] * l[:, 0] * pw + pcx
+    cy = v[:, 1] * l[:, 1] * ph + pcy
+    w = jnp.exp(v[:, 2] * l[:, 2]) * pw
+    h = jnp.exp(v[:, 3] * l[:, 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5, cy + h * 0.5], axis=1)
+
+
+def iou_matrix(boxes):
+    """Pairwise IoU [P, P] for boxes [P, 4] (xmin, ymin, xmax, ymax)."""
+    b = boxes.astype(jnp.float32)
+    area = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms_mask(boxes, scores, iou_threshold, score_threshold, max_keep):
+    """Greedy NMS keep-mask [P] with static shapes: `max_keep` rounds of
+    pick-best-then-suppress (the vectorized form of the reference's
+    applyNMSFast)."""
+    p = boxes.shape[0]
+    iou = iou_matrix(boxes)
+    alive = scores > score_threshold
+    keep = jnp.zeros((p,), bool)
+
+    def body(_, state):
+        alive, keep = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        any_alive = jnp.any(alive)
+        keep = jnp.where(any_alive, keep.at[best].set(True), keep)
+        # suppress overlaps with the pick (and the pick itself)
+        suppress = (iou[best] >= iou_threshold) | \
+            (jnp.arange(p) == best)
+        alive = alive & ~suppress & jnp.full((p,), any_alive)
+        return alive, keep
+
+    _, keep = jax.lax.fori_loop(0, min(max_keep, p), body, (alive, keep))
+    return keep
+
+
+@register_op('detection_output')
+def _detection_output(ctx, ins, attrs):
+    """Inputs: Loc [N, P, 4] offsets, Conf [N, P, C] logits,
+    PriorBox [P, 8].  Output [N, keep_top_k, 6] rows
+    (label, score, xmin, ymin, xmax, ymax), label -1 past the detections."""
+    loc = first(ins, 'Loc')
+    conf = first(ins, 'Conf')
+    prior = first(ins, 'PriorBox')
+    num_classes = int(attrs['num_classes'])
+    background = int(attrs.get('background_label_id', 0))
+    nms_threshold = float(attrs.get('nms_threshold', 0.45))
+    conf_threshold = float(attrs.get('confidence_threshold', 0.01))
+    nms_top_k = int(attrs.get('nms_top_k', 400))
+    keep_top_k = int(attrs.get('top_k', attrs.get('keep_top_k', 200)))
+
+    probs = jax.nn.softmax(conf.astype(jnp.float32), axis=-1)  # [N, P, C]
+
+    def per_image(loc_i, probs_i):
+        boxes = decode_box(prior, loc_i)  # [P, 4]
+        p = boxes.shape[0]
+
+        def per_class(c_probs):
+            return nms_mask(boxes, c_probs, nms_threshold, conf_threshold,
+                            nms_top_k)
+
+        cls_probs = jnp.moveaxis(probs_i, 1, 0)  # [C, P]
+        keep = jax.vmap(per_class)(cls_probs)  # [C, P]
+        keep = keep.at[background].set(jnp.zeros((p,), bool))
+        scores = jnp.where(keep, cls_probs, 0.0).reshape(-1)  # [C*P]
+        k = min(keep_top_k, scores.shape[0])
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        top_cls = (top_idx // p).astype(jnp.float32)
+        top_box = boxes[top_idx % p]
+        valid = top_scores > 0
+        label = jnp.where(valid, top_cls, -1.0)
+        rows = jnp.concatenate(
+            [label[:, None], top_scores[:, None], top_box], axis=1)
+        rows = jnp.where(valid[:, None], rows,
+                         jnp.concatenate([jnp.full((k, 1), -1.0),
+                                          jnp.zeros((k, 5))], axis=1))
+        if k < keep_top_k:
+            pad = jnp.zeros((keep_top_k - k, 6)).at[:, 0].set(-1.0)
+            rows = jnp.concatenate([rows, pad], axis=0)
+        return rows
+
+    out = jax.vmap(per_image)(loc.astype(jnp.float32), probs)
+    return {'Out': [out]}

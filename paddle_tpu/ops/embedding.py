@@ -1,14 +1,18 @@
-"""Embedding lookup.
+"""Embedding lookup + sparse-gradient assembly.
 
-Reference parity: paddle/operators/lookup_table_op.* (forward gather;
-sparse SelectedRows grad).  On TPU the gather is a single HLO gather; the
-autodiff grad is a dense scatter-add which XLA handles natively, so
-`is_sparse` is a no-op hint here (SelectedRows applies in ops/optim_ops.py
-when explicitly fed).
+Reference parity: paddle/operators/lookup_table_op.* — forward gather;
+with `is_sparse` the grad kernel emits a SelectedRows instead of a dense
+vocab-height tensor (lookup_table_op.cc:52 LookupTableGradKernel).  On TPU
+the gather is one HLO gather; the sparse grad path is realised by
+core/backward.py diffing w.r.t. the lookup *outputs* and a
+`sparse_grad_assemble` op packing (ids, output-cotangents) into a
+core/selected_rows.SelectedRows, which the optimizer ops apply row-wise
+into the donated parameter buffer.
 """
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 from .common import first, out
 
 
@@ -27,3 +31,32 @@ def _lookup_table(ctx, ins, attrs):
         mask = (ids != pad)[..., None]
         y = jnp.where(mask, y, jnp.zeros_like(y))
     return out(y)
+
+
+@register_op('sparse_grad_assemble')
+def _sparse_grad_assemble(ctx, ins, attrs):
+    """Pack one or more (Ids, OutGrad) pairs — every sparse lookup of one
+    shared table — into a single SelectedRows grad.  Rows of a
+    `padding_idx` id get zero values (the dense autodiff's where-mask
+    blocks those grads; the sparse path must too)."""
+    height = int(attrs['height'])
+    pad = attrs.get('padding_idx', None)
+    rows_list, vals_list = [], []
+    for ids, g in zip(ins['Ids'], ins['OutGrad']):
+        ids = ids.astype(jnp.int32)
+        if ids.ndim >= 2 and ids.shape[-1] == 1:
+            ids = ids.squeeze(-1)
+        dim = g.shape[-1]
+        rows = ids.reshape(-1)
+        vals = g.astype(jnp.float32).reshape(-1, dim)
+        if pad is not None:
+            # zero the values but KEEP rows == pad: lazy sparse optimizers
+            # then touch only the always-masked padding row, never a real
+            # vocabulary entry
+            p = pad if pad >= 0 else height + pad
+            vals = jnp.where((rows != p)[:, None], vals,
+                             jnp.zeros_like(vals))
+        rows_list.append(rows)
+        vals_list.append(vals)
+    return out(SelectedRows(jnp.concatenate(rows_list),
+                            jnp.concatenate(vals_list), height))

@@ -4,12 +4,17 @@ Reference parity: paddle/operators/{sgd,momentum,adam,adamax,adagrad,
 decayed_adagrad,adadelta,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.*.
 Each is a functional update: reads param/grad/moments, returns new values;
 the executor's donated persistable state makes them in-place on device.
-Sparse (SelectedRows) grads arrive as a (rows, values) pair handled by
-segment-sum scatter.
+
+Sparse grads arrive as a core/selected_rows.SelectedRows (or a raw
+(rows, values) pair): sgd/adagrad/adam apply them ROW-WISE — scatter-adds
+into the donated buffers, the vocab-height dense grad never materializes
+(parity: sgd_op.cc / adagrad_op.cc sparse branches; adam applies lazily
+on the touched rows).  Other optimizers densify via scatter-add.
 """
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows, merge_duplicate_rows
 from .common import first
 
 
@@ -17,22 +22,41 @@ def _p32(x):
     return x.astype(jnp.float32)
 
 
+def _as_sparse(grad):
+    """Normalize a sparse grad to (rows, values) or None if dense."""
+    if isinstance(grad, SelectedRows):
+        return grad.rows, grad.values
+    if isinstance(grad, tuple):
+        rows, values = grad
+        return rows.astype(jnp.int32).reshape(-1), _p32(values)
+    return None
+
+
 def _sparse_to_update(param, grad):
-    """If grad is a (rows, values) tuple, scatter-add values into a dense
-    zero grad (TPU handles dense scatter efficiently)."""
+    """Densify a sparse grad by scatter-add (fallback for optimizers
+    without a row-wise sparse rule)."""
+    if isinstance(grad, SelectedRows):
+        return grad.to_dense().astype(jnp.float32)
     if isinstance(grad, tuple):
         rows, values = grad
         dense = jnp.zeros(param.shape, jnp.float32)
-        return dense.at[rows.astype(jnp.int32)].add(_p32(values))
+        return dense.at[rows.astype(jnp.int32).reshape(-1)].add(
+            _p32(values))
     return _p32(grad)
 
 
 @register_op('sgd')
 def _sgd(ctx, ins, attrs):
     p = first(ins, 'Param')
-    g = _sparse_to_update(p, first(ins, 'Grad'))
+    grad = first(ins, 'Grad')
     lr = _p32(first(ins, 'LearningRate')).reshape(())
-    return {'ParamOut': [(_p32(p) - lr * g).astype(p.dtype)]}
+    sp = _as_sparse(grad)
+    if sp is not None:
+        # row-wise apply: duplicates accumulate (linear update)
+        rows, values = sp
+        p_new = _p32(p).at[rows].add(-lr * _p32(values))
+        return {'ParamOut': [p_new.astype(p.dtype)]}
+    return {'ParamOut': [(_p32(p) - lr * _p32(grad)).astype(p.dtype)]}
 
 
 @register_op('momentum')
@@ -53,7 +77,7 @@ def _momentum(ctx, ins, attrs):
 @register_op('adam')
 def _adam(ctx, ins, attrs):
     p = first(ins, 'Param')
-    g = _sparse_to_update(p, first(ins, 'Grad'))
+    grad = first(ins, 'Grad')
     m = _p32(first(ins, 'Moment1'))
     v = _p32(first(ins, 'Moment2'))
     lr = _p32(first(ins, 'LearningRate')).reshape(())
@@ -62,9 +86,25 @@ def _adam(ctx, ins, attrs):
     b1 = attrs.get('beta1', 0.9)
     b2 = attrs.get('beta2', 0.999)
     eps = attrs.get('epsilon', 1e-8)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    sp = _as_sparse(grad)
+    if sp is not None:
+        # lazy sparse adam: moments decay and the param moves only on
+        # touched rows; duplicate rows merge first (nonlinear update)
+        rows, values = sp
+        rows, g, valid = merge_duplicate_rows(rows, _p32(values))
+        vmask = valid[:, None]
+        m_row = b1 * m[rows] + (1 - b1) * g
+        v_row = b2 * v[rows] + (1 - b2) * jnp.square(g)
+        m_new = m.at[rows].add(jnp.where(vmask, m_row - m[rows], 0.0))
+        v_new = v.at[rows].add(jnp.where(vmask, v_row - v[rows], 0.0))
+        step = -lr_t * m_row / (jnp.sqrt(v_row) + eps)
+        p_new = _p32(p).at[rows].add(jnp.where(vmask, step, 0.0))
+        return {'ParamOut': [p_new.astype(p.dtype)], 'Moment1Out': [m_new],
+                'Moment2Out': [v_new]}
+    g = _p32(grad)
     m_new = b1 * m + (1 - b1) * g
     v_new = b2 * v + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = _p32(p) - lr_t * m_new / (jnp.sqrt(v_new) + eps)
     return {'ParamOut': [p_new.astype(p.dtype)], 'Moment1Out': [m_new],
             'Moment2Out': [v_new]}
@@ -91,10 +131,23 @@ def _adamax(ctx, ins, attrs):
 @register_op('adagrad')
 def _adagrad(ctx, ins, attrs):
     p = first(ins, 'Param')
-    g = _sparse_to_update(p, first(ins, 'Grad'))
+    grad = first(ins, 'Grad')
     mom = _p32(first(ins, 'Moment'))
     lr = _p32(first(ins, 'LearningRate')).reshape(())
     eps = attrs.get('epsilon', 1e-6)
+    sp = _as_sparse(grad)
+    if sp is not None:
+        # reference adagrad_op.cc sparse branch: merge duplicate rows,
+        # then accumulate + step on the touched rows only
+        rows, values = sp
+        rows, g, valid = merge_duplicate_rows(rows, _p32(values))
+        vmask = valid[:, None]
+        mom_row = mom[rows] + jnp.square(g)
+        mom_new = mom.at[rows].add(jnp.where(vmask, jnp.square(g), 0.0))
+        step = -lr * g / (jnp.sqrt(mom_row) + eps)
+        p_new = _p32(p).at[rows].add(jnp.where(vmask, step, 0.0))
+        return {'ParamOut': [p_new.astype(p.dtype)], 'MomentOut': [mom_new]}
+    g = _p32(grad)
     mom_new = mom + jnp.square(g)
     p_new = _p32(p) - lr * g / (jnp.sqrt(mom_new) + eps)
     return {'ParamOut': [p_new.astype(p.dtype)], 'MomentOut': [mom_new]}

@@ -23,7 +23,9 @@ def profiler(state='All', sorted_key=None, log_dir='/tmp/paddle_tpu_prof'):
     try:
         jax.profiler.start_trace(log_dir)
         started = True
-    except Exception:
+    except RuntimeError as e:  # e.g. a trace is already running
+        import warnings
+        warnings.warn("profiler trace did not start: %s" % e)
         started = False
     t0 = time.time()
     try:
@@ -72,3 +74,21 @@ class RecordEvent(object):
 
 def get_events():
     return list(_events)
+
+
+def cost_analysis(program, feed, fetch_list, scope=None, place=None):
+    """XLA cost analysis of one compiled step: flops, bytes accessed,
+    estimated seconds (A1 — the counterpart of the reference's per-op
+    profiler table; here the whole block is ONE fused computation, so the
+    costs are per-step aggregates straight from the compiler)."""
+    from .core.executor import Executor
+    from .core.place import default_place
+    exe = Executor(place or default_place())
+    raw, args = exe.compile_raw(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope)
+    import jax as _jax
+    compiled = _jax.jit(raw).lower(*args).compile()
+    costs = compiled.cost_analysis()
+    if isinstance(costs, (list, tuple)):
+        costs = costs[0] if costs else {}
+    return dict(costs or {})

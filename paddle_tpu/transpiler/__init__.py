@@ -1,0 +1,3 @@
+from .memory_optimize import memory_optimize, release_memory  # noqa: F401
+
+__all__ = ['memory_optimize', 'release_memory']

@@ -1,0 +1,59 @@
+"""P14 — memory-optimize transpiler: rematerialization policies.
+
+Reference parity: python/paddle/v2/fluid/memory_optimization_transpiler.py
+— the reference rewrites the program so dead vars reuse buffers.  On TPU
+the buffer-lifetime problem belongs to XLA; what the user controls is the
+forward-activation working set of the fused fwd+bwd step.  memory_optimize
+therefore arms `jax.checkpoint` (remat) over the autodiff closure: the
+backward pass recomputes activations instead of keeping them alive —
+trading FLOPs for HBM exactly like the reference trades copies for reuse.
+
+Levels:
+  'full'  — save nothing; recompute every activation in the backward
+            (jax.checkpoint policy nothing_saveable): smallest memory.
+  'dots'  — save matmul/conv outputs, recompute elementwise chains
+            (dots_saveable): the usual sweet spot on MXU-heavy models.
+  None    — turn remat back off.
+"""
+import jax
+
+__all__ = ['memory_optimize', 'release_memory', 'get_remat_policy']
+
+_POLICIES = {
+    'full': None,  # nothing saveable -> plain jax.checkpoint
+    'dots': 'dots_saveable',
+}
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level='dots'):
+    """Mark `input_program` for rematerialization.  The executor wraps the
+    traced fwd+bwd closure in jax.checkpoint with the chosen policy on the
+    next (re)compile."""
+    if level is not None and level not in _POLICIES:
+        raise ValueError("level must be one of %s or None"
+                         % sorted(_POLICIES))
+    input_program._remat_level = level
+    input_program._bump_version()  # invalidate executor plan caches
+    if print_log:
+        print("memory_optimize: remat level = %r" % level)
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Reference release_memory parity: buffer release is XLA's job (donated
+    inputs + liveness); nothing to rewrite — kept for API compatibility."""
+    return input_program
+
+
+def get_remat_policy(program):
+    """Resolve the program's remat marker to a jax.checkpoint wrapper, or
+    None."""
+    level = getattr(program, '_remat_level', None)
+    if level is None:
+        return None
+    policy_name = _POLICIES[level]
+    if policy_name is None:
+        return lambda f: jax.checkpoint(f)
+    policy = getattr(jax.checkpoint_policies, policy_name)
+    return lambda f: jax.checkpoint(f, policy=policy)

@@ -1,0 +1,3 @@
+from . import net_drawer  # noqa: F401
+
+__all__ = ['net_drawer']

@@ -1,0 +1,59 @@
+"""P17 — net_drawer: render a Program as graphviz dot text.
+
+Reference parity: python/paddle/v2/fluid/net_drawer.py (draw_graph over
+ops/vars with graphviz).  Pure-text .dot output — no graphviz binary
+needed; `dot -Tpng` renders it wherever available.
+"""
+import html
+
+__all__ = ['draw_graph', 'draw_block_graphviz']
+
+OP_STYLE = 'shape=box, style=rounded, fillcolor="#a0d0ff", style=filled'
+VAR_STYLE = 'shape=ellipse, fillcolor="#dddddd", style=filled'
+PARAM_STYLE = 'shape=ellipse, fillcolor="#ffe0a0", style=filled'
+
+
+def _q(name):
+    return '"%s"' % html.escape(str(name), quote=False).replace('"', "'")
+
+
+def draw_block_graphviz(block, highlights=None, path=None):
+    """Dot text for one block: op nodes + var nodes + data edges."""
+    highlights = set(highlights or [])
+    lines = ['digraph G {', '  rankdir=TB;']
+    params = {p.name for p in block.all_parameters()} if hasattr(
+        block, 'all_parameters') else set()
+    seen_vars = set()
+
+    def var_node(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        style = PARAM_STYLE if name in params else VAR_STYLE
+        if name in highlights:
+            style += ', color=red, penwidth=2'
+        lines.append('  %s [%s];' % (_q(name), style))
+
+    for i, op in enumerate(block.ops):
+        op_id = 'op_%d_%s' % (i, op.type)
+        lines.append('  %s [label=%s, %s];' % (_q(op_id), _q(op.type),
+                                               OP_STYLE))
+        for name in op.input_arg_names:
+            var_node(name)
+            lines.append('  %s -> %s;' % (_q(name), _q(op_id)))
+        for name in op.output_arg_names:
+            var_node(name)
+            lines.append('  %s -> %s;' % (_q(op_id), _q(name)))
+    lines.append('}')
+    dot = '\n'.join(lines)
+    if path:
+        with open(path, 'w') as f:
+            f.write(dot)
+    return dot
+
+
+def draw_graph(startup_program, main_program, path=None, **kwargs):
+    """Reference draw_graph signature: renders main_program's global
+    block (startup accepted for parity)."""
+    return draw_block_graphviz(main_program.global_block(), path=path,
+                               **kwargs)

@@ -13,6 +13,15 @@ def test_ctr_trains(arch):
     opt = fluid.optimizer.AdamOptimizer(learning_rate=0.003)
     opt.minimize(avg_cost)
 
+    # the high-dim tables must take the SelectedRows path — no dense
+    # vocab-height grad (reference lookup_table_op.cc:52 sparse grad)
+    main = fluid.default_main_program()
+    assemble_outs = [
+        op.outputs['Out'][0] for op in main.global_block().ops
+        if op.type == 'sparse_grad_assemble']
+    assert any('embed_' in g for g in assemble_outs), \
+        'embedding tables did not take the sparse-grad path'
+
     place = fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())

@@ -1,0 +1,79 @@
+"""V5-V7 — a v2-style book demo: MNIST MLP through
+parameters.create + trainer.SGD(...).train(reader, event_handler) +
+paddle.infer.
+
+Reference parity: python/paddle/v2/tests usage pattern and the v2
+recognize_digits demo (trainer.py:86 SGD.train, inference.py infer).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import highlevel
+from paddle_tpu.models import mnist
+
+
+def test_v2_trainer_event_loop_and_infer():
+    img, label, predict, avg_cost, acc = mnist.build('mlp')
+
+    parameters = highlevel.parameters.create(avg_cost)
+    assert len(parameters.keys()) >= 6  # 3 fc layers: w + b each
+    w0 = parameters.get(parameters.keys()[0])
+    assert np.isfinite(w0).all()
+
+    trainer = highlevel.SGD(
+        cost=avg_cost, parameters=parameters,
+        update_equation=fluid.optimizer.AdamOptimizer(
+            learning_rate=0.003),
+        metrics={'acc': acc})
+
+    r = np.random.RandomState(0)
+    centers = r.randn(10, 1, 28, 28).astype('float32')
+
+    def reader():
+        rr = np.random.RandomState(1)
+        for _ in range(12):
+            lab = rr.randint(0, 10, (32, 1)).astype('int64')
+            imgs = centers[lab[:, 0]] + \
+                0.1 * rr.randn(32, 1, 28, 28).astype('float32')
+            yield list(zip(imgs, lab))
+
+    events = {'begin_pass': 0, 'end_pass': 0, 'iters': 0, 'costs': []}
+
+    def handler(e):
+        if isinstance(e, highlevel.event.BeginPass):
+            events['begin_pass'] += 1
+        elif isinstance(e, highlevel.event.EndPass):
+            events['end_pass'] += 1
+            assert 'acc' in e.metrics
+        elif isinstance(e, highlevel.event.EndIteration):
+            events['iters'] += 1
+            events['costs'].append(e.cost)
+            assert 'acc' in e.metrics
+
+    def batched():
+        for batch in reader():
+            yield batch
+
+    trainer.train(batched, num_passes=2, event_handler=handler)
+
+    assert events['begin_pass'] == 2 and events['end_pass'] == 2
+    assert events['iters'] == 24
+    costs = events['costs']
+    assert np.mean(costs[-4:]) < np.mean(costs[:4])
+
+    # test(): for_test program, average metrics
+    result = trainer.test(batched)
+    assert isinstance(result, highlevel.event.TestResult)
+    assert np.isfinite(result.cost)
+    assert result.metrics['acc'] > 0.5  # separable clusters are learnable
+
+    # infer(): prediction rows sum to 1 (softmax) and pick the centers
+    batch = next(batched())
+    inputs = [(x,) for x, _ in batch[:8]]
+    probs = highlevel.infer(output_layer=predict, parameters=parameters,
+                            input=inputs)
+    assert probs.shape == (8, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(8), rtol=1e-4)
+    pred_lab = probs.argmax(axis=1)
+    true_lab = np.array([int(l) for _, l in batch[:8]])
+    assert (pred_lab == true_lab).mean() > 0.5

@@ -32,11 +32,18 @@ def run_op(op_type, inputs, attrs=None, seed=0):
     """Run a registered op impl directly; inputs maps slot -> array or
     [arrays]. Returns dict slot -> [arrays]."""
     impl = get_op_impl(op_type)
+    def _stage(x):
+        if isinstance(x, tuple):  # sparse (rows, values) pair
+            return tuple(jnp.asarray(e) for e in x)
+        try:
+            return jnp.asarray(x)
+        except TypeError:  # opaque op values (e.g. TArray) pass through
+            return x
+
     ins = {}
     for slot, v in (inputs or {}).items():
         vals = v if isinstance(v, list) else [v]
-        ins[slot] = [tuple(jnp.asarray(e) for e in x) if isinstance(x, tuple)
-                     else jnp.asarray(x) for x in vals]
+        ins[slot] = [_stage(x) for x in vals]
     outs = impl.compute(_Ctx(seed), ins, dict(attrs or {}))
     return outs
 
@@ -61,7 +68,9 @@ class OpTest(object):
         like the reference's get_numeric_gradient."""
         impl = get_op_impl(self.op_type)
         attrs = dict(self.attrs or {})
-        base = {s: (v if isinstance(v, (list, tuple)) else [v])
+        # same convention as run_op: list = multi-input slot, tuple = one
+        # sparse (rows, values) pair
+        base = {s: (v if isinstance(v, list) else [v])
                 for s, v in self.inputs.items()}
 
         def f(diff_vals):

@@ -1,0 +1,110 @@
+"""Linear-chain CRF op tests vs brute-force enumeration.
+
+Reference parity: python/paddle/v2/fluid/tests/test_linear_chain_crf_op.py
+and test_crf_decoding_op.py — here the reference implementation is an
+explicit enumeration over all tag paths (small N, T), which checks both the
+log-partition recursion and Viterbi exactly.
+"""
+import itertools
+
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(5)
+N = 3  # tags
+T = 4  # max time
+B = 3
+
+
+def _paths_scores(emission, transition, length):
+    """All (path, score) pairs for one sequence of `length`."""
+    start, end, trans = transition[0], transition[1], transition[2:]
+    for path in itertools.product(range(N), repeat=length):
+        s = start[path[0]] + end[path[-1]]
+        s += sum(emission[t, path[t]] for t in range(length))
+        s += sum(trans[path[t], path[t + 1]] for t in range(length - 1))
+        yield path, s
+
+
+def test_linear_chain_crf_vs_enumeration():
+    emission = rng.randn(B, T, N).astype('float32')
+    transition = rng.randn(N + 2, N).astype('float32')
+    labels = rng.randint(0, N, (B, T)).astype('int64')
+    lengths = np.array([4, 2, 3], dtype='int64')
+
+    outs = run_op('linear_chain_crf',
+                  {'Emission': emission, 'Transition': transition,
+                   'Label': labels, 'EmissionLen': lengths})
+    got = np.asarray(outs['LogLikelihood'][0]).reshape(-1)
+
+    for b in range(B):
+        ln = int(lengths[b])
+        scores = dict(_paths_scores(emission[b], transition, ln))
+        log_z = np.log(sum(np.exp(s) for s in scores.values()))
+        gold = scores[tuple(labels[b, :ln])]
+        np.testing.assert_allclose(got[b], log_z - gold, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_crf_decoding_vs_enumeration():
+    emission = rng.randn(B, T, N).astype('float32')
+    transition = rng.randn(N + 2, N).astype('float32')
+    lengths = np.array([4, 3, 2], dtype='int64')
+    outs = run_op('crf_decoding',
+                  {'Emission': emission, 'Transition': transition,
+                   'EmissionLen': lengths})
+    path = np.asarray(outs['ViterbiPath'][0])[..., 0]
+    for b in range(B):
+        ln = int(lengths[b])
+        best = max(_paths_scores(emission[b], transition, ln),
+                   key=lambda kv: kv[1])[0]
+        np.testing.assert_array_equal(path[b, :ln], np.asarray(best))
+        assert np.all(path[b, ln:] == 0)  # padded tail zeroed
+
+
+def test_crf_decoding_with_label_emits_agreement():
+    """With Label, output is 1 where Viterbi AGREES with gold
+    (crf_decoding_op.h: path[i] = label[i] == path[i] ? 1 : 0)."""
+    emission = rng.randn(1, T, N).astype('float32')
+    transition = rng.randn(N + 2, N).astype('float32')
+    lengths = np.array([T], dtype='int64')
+    decode = np.asarray(run_op(
+        'crf_decoding', {'Emission': emission, 'Transition': transition,
+                         'EmissionLen': lengths})['ViterbiPath'][0])[..., 0]
+    lab = decode.copy().astype('int64')
+    lab[0, 1] = (lab[0, 1] + 1) % N  # force one disagreement
+    hit = np.asarray(run_op(
+        'crf_decoding', {'Emission': emission, 'Transition': transition,
+                         'Label': lab, 'EmissionLen': lengths}
+    )['ViterbiPath'][0])[..., 0]
+    want = (decode == lab).astype('int64')
+    np.testing.assert_array_equal(hit, want)
+    assert hit[0, 1] == 0 and hit.sum() == T - 1
+
+
+def test_crf_grad_matches_fd():
+    """d(nll)/d(emission) via jax.grad vs finite differences."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.crf import crf_nll
+
+    emission = rng.randn(2, 3, N).astype('float32')
+    transition = rng.randn(N + 2, N).astype('float32')
+    labels = rng.randint(0, N, (2, 3)).astype('int32')
+    lengths = jnp.asarray([3, 2], jnp.int32)
+
+    def f(e):
+        return jnp.sum(crf_nll(e, lengths, jnp.asarray(transition),
+                               jnp.asarray(labels)))
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(emission)))
+    eps = 1e-3
+    for idx in [(0, 0, 0), (0, 2, 1), (1, 1, 2), (1, 2, 0)]:
+        ep = emission.copy()
+        ep[idx] += eps
+        em = emission.copy()
+        em[idx] -= eps
+        fd = (float(f(jnp.asarray(ep))) - float(f(jnp.asarray(em)))) / \
+            (2 * eps)
+        np.testing.assert_allclose(g[idx], fd, rtol=5e-2, atol=5e-3)

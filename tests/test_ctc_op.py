@@ -1,0 +1,100 @@
+"""CTC (warpctc) op tests vs brute-force path enumeration.
+
+Reference parity: python/paddle/v2/fluid/tests/test_warpctc_op.py — the
+reference checks against Baidu warp-ctc; here the reference value comes
+from enumerating every length-T alignment and collapsing (exact for tiny
+V, T).
+"""
+import itertools
+
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(9)
+
+
+def _collapse(path, blank=0):
+    out = []
+    prev = None
+    for p in path:
+        if p != prev and p != blank:
+            out.append(p)
+        prev = p
+    return tuple(out)
+
+
+def _brute_nll(log_probs, label, blank=0):
+    """-log sum over all alignments collapsing to `label`."""
+    t, v = log_probs.shape
+    total = -np.inf
+    for path in itertools.product(range(v), repeat=t):
+        if _collapse(path, blank) == tuple(label):
+            s = sum(log_probs[i, path[i]] for i in range(t))
+            total = np.logaddexp(total, s)
+    return -total
+
+
+def _log_softmax(x):
+    x = x - x.max(axis=-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+
+def test_warpctc_vs_enumeration():
+    B, T, V, L = 3, 4, 3, 2
+    logits = rng.randn(B, T, V).astype('float32')
+    labels = np.array([[1, 2], [2, 0], [1, 0]], dtype='int64')
+    label_len = np.array([2, 1, 1], dtype='int64')
+    logit_len = np.array([4, 3, 4], dtype='int64')
+    outs = run_op('warpctc',
+                  {'Logits': logits, 'Label': labels,
+                   'LogitsLen': logit_len, 'LabelLen': label_len})
+    got = np.asarray(outs['Loss'][0]).reshape(-1)
+    lp = _log_softmax(logits.astype('float64'))
+    for b in range(B):
+        want = _brute_nll(lp[b, :logit_len[b]],
+                          labels[b, :label_len[b]])
+        np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_norm_by_times():
+    B, T, V = 2, 3, 3
+    logits = rng.randn(B, T, V).astype('float32')
+    labels = np.array([[1], [2]], dtype='int64')
+    llen = np.array([1, 1], dtype='int64')
+    tlen = np.array([3, 2], dtype='int64')
+    plain = np.asarray(run_op(
+        'warpctc', {'Logits': logits, 'Label': labels, 'LogitsLen': tlen,
+                    'LabelLen': llen})['Loss'][0]).reshape(-1)
+    normed = np.asarray(run_op(
+        'warpctc', {'Logits': logits, 'Label': labels, 'LogitsLen': tlen,
+                    'LabelLen': llen},
+        {'norm_by_times': True})['Loss'][0]).reshape(-1)
+    np.testing.assert_allclose(normed, plain / tlen, rtol=1e-5)
+
+
+def test_ctc_grad_matches_fd():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.ctc import ctc_loss
+
+    B, T, V = 2, 4, 3
+    logits = rng.randn(B, T, V).astype('float32')
+    labels = jnp.asarray([[1, 2], [2, 0]], jnp.int32)
+    llen = jnp.asarray([2, 1], jnp.int32)
+    tlen = jnp.asarray([4, 3], jnp.int32)
+
+    def f(x):
+        lp = jax.nn.log_softmax(x, axis=-1)
+        return jnp.sum(ctc_loss(lp, tlen, labels, llen))
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(logits)))
+    eps = 1e-3
+    for idx in [(0, 0, 1), (0, 3, 2), (1, 1, 0), (1, 2, 2)]:
+        xp = logits.copy()
+        xp[idx] += eps
+        xm = logits.copy()
+        xm[idx] -= eps
+        fd = (float(f(jnp.asarray(xp))) - float(f(jnp.asarray(xm)))) / \
+            (2 * eps)
+        np.testing.assert_allclose(g[idx], fd, rtol=5e-2, atol=5e-3)

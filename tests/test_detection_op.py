@@ -1,0 +1,69 @@
+"""detection_output (SSD) op tests vs hand-built scenarios.
+
+Reference parity: python/paddle/v2/fluid/tests/test_detection_output_op.py
+(decode + softmax + NMS + top-k).
+"""
+import numpy as np
+
+from op_test import run_op
+
+
+def _prior(boxes):
+    """[P, 4] corner boxes -> [P, 8] with unit variances."""
+    p = np.asarray(boxes, 'float32')
+    return np.concatenate([p, np.ones_like(p)], axis=1)
+
+
+def test_decode_identity_when_loc_zero():
+    from paddle_tpu.ops.detection import decode_box
+    import jax.numpy as jnp
+    prior = _prior([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.9, 0.8]])
+    got = np.asarray(decode_box(jnp.asarray(prior),
+                                jnp.zeros((2, 4), 'float32')))
+    np.testing.assert_allclose(got, prior[:, :4], rtol=1e-5, atol=1e-6)
+
+
+def test_iou_and_nms():
+    from paddle_tpu.ops.detection import iou_matrix, nms_mask
+    import jax.numpy as jnp
+    boxes = jnp.asarray([[0, 0, 1, 1], [0, 0, 1, 1.05], [2, 2, 3, 3]],
+                        jnp.float32)
+    iou = np.asarray(iou_matrix(boxes))
+    assert iou[0, 0] > 0.999
+    assert 0.9 < iou[0, 1] < 1.0
+    assert iou[0, 2] == 0.0
+    keep = np.asarray(nms_mask(boxes, jnp.asarray([0.9, 0.8, 0.7]),
+                               0.5, 0.1, 10))
+    # box1 suppressed by box0; box2 disjoint -> kept
+    np.testing.assert_array_equal(keep, [True, False, True])
+
+
+def test_detection_output_end_to_end():
+    # 3 priors: two overlapping at top-left, one at bottom-right
+    prior = _prior([[0.0, 0.0, 0.4, 0.4],
+                    [0.02, 0.02, 0.42, 0.42],
+                    [0.6, 0.6, 0.95, 0.95]])
+    loc = np.zeros((1, 3, 4), 'float32')  # no offset: boxes = priors
+    # class 0 = background; prior0 & prior1 -> class 1; prior2 -> class 2
+    conf = np.zeros((1, 3, 3), 'float32')
+    conf[0, 0, 1] = 4.0
+    conf[0, 1, 1] = 3.0   # overlaps prior0, same class -> suppressed
+    conf[0, 2, 2] = 5.0
+    out = np.asarray(run_op(
+        'detection_output',
+        {'Loc': loc, 'Conf': conf, 'PriorBox': prior},
+        {'num_classes': 3, 'background_label_id': 0,
+         'nms_threshold': 0.5, 'confidence_threshold': 0.1,
+         'top_k': 4})['Out'][0])
+    assert out.shape == (1, 4, 6)
+    labels = out[0, :, 0]
+    # two detections: class 2 (highest prob) then class 1; rest padding
+    det = out[0][labels >= 0]
+    assert det.shape[0] == 2
+    order = det[:, 1].argsort()[::-1]
+    det = det[order]
+    assert int(det[0, 0]) == 2 and int(det[1, 0]) == 1
+    np.testing.assert_allclose(det[0, 2:], prior[2, :4], atol=1e-5)
+    np.testing.assert_allclose(det[1, 2:], prior[0, :4], atol=1e-5)
+    # padding rows have label -1
+    assert np.all(out[0, 2:, 0] == -1)

@@ -1,0 +1,119 @@
+"""io.py tests: save/load params, inference-model round-trip,
+checkpoint/resume.
+
+Reference parity: python/paddle/v2/fluid/io.py usage in the book tests
+(save_inference_model / load_inference_model) and A2 checkpoint/resume.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+
+
+def _build_model():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        hidden = fluid.layers.fc(input=x, size=8, act='relu')
+        pred = fluid.layers.fc(input=hidden, size=1, act=None)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, startup, pred, loss
+
+
+def _train_steps(exe, main, loss, n, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(4, 1).astype('float32')
+    res = None
+    for _ in range(n):
+        xb = rng.randn(8, 4).astype('float32')
+        res = exe.run(main, feed={'x': xb, 'y': xb @ w},
+                      fetch_list=[loss])
+    return float(np.ravel(res[0])[0])
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    _train_steps(exe, main, loss, 3)
+
+    scope = fluid.global_scope()
+    params = {p.name: np.asarray(scope.find_var(p.name))
+              for p in main.list_vars() if io.is_parameter(p)}
+    assert params  # model has parameters
+    io.save_params(exe, str(tmp_path / 'params'), main)
+
+    # clobber, then reload and compare
+    for name, val in params.items():
+        scope.set(name, np.zeros_like(val))
+    io.load_params(exe, str(tmp_path / 'params'), main)
+    for name, val in params.items():
+        np.testing.assert_allclose(np.asarray(scope.find_var(name)), val,
+                                   err_msg=name)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    _train_steps(exe, main, loss, 2)
+
+    xb = np.random.RandomState(1).randn(5, 4).astype('float32')
+    infer_prog = io.get_inference_program([pred], main)
+    want = exe.run(infer_prog, feed={'x': xb}, fetch_list=[pred])[0]
+
+    io.save_inference_model(str(tmp_path / 'model'), ['x'], [pred], exe,
+                            main)
+    prog, feed_names, fetch_vars = io.load_inference_model(
+        str(tmp_path / 'model'), exe)
+    assert feed_names == ['x']
+    got = exe.run(prog, feed={'x': xb},
+                  fetch_list=fetch_vars)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_checkpoint_resume(tmp_path):
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    _train_steps(exe, main, loss, 3)
+    io.save_checkpoint(exe, str(tmp_path / 'ckpt'), main, step=3)
+
+    # capture full persistable state (params + opt state)
+    scope = fluid.global_scope()
+    persist = {v.name: np.asarray(scope.find_var(v.name))
+               for v in main.list_vars()
+               if v.persistable and scope.find_var(v.name) is not None}
+
+    # keep training, diverging from the checkpoint
+    _train_steps(exe, main, loss, 3, seed=9)
+    changed = any(
+        not np.allclose(np.asarray(scope.find_var(n)), v)
+        for n, v in persist.items())
+    assert changed
+
+    # resume: every persistable back to its checkpointed value
+    step = io.load_checkpoint(exe, str(tmp_path / 'ckpt'), main)
+    assert step == 3
+    for n, v in persist.items():
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), v,
+                                   err_msg=n)
+
+
+def test_embedding_lookup_and_padding_idx():
+    """lookup_table forward parity (operators/lookup_table_op.cc)."""
+    from op_test import run_op
+    rng = np.random.RandomState(2)
+    w = rng.randn(10, 4).astype('float32')
+    ids = np.array([[1], [9], [0]], dtype='int64')
+    got = np.asarray(run_op('lookup_table', {'W': w, 'Ids': ids})['Out'][0])
+    np.testing.assert_allclose(got, w[[1, 9, 0]], rtol=1e-6)
+    got_pad = np.asarray(run_op('lookup_table', {'W': w, 'Ids': ids},
+                                {'padding_idx': 0})['Out'][0])
+    assert np.all(got_pad[2] == 0)
+    np.testing.assert_allclose(got_pad[:2], w[[1, 9]], rtol=1e-6)

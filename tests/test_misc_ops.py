@@ -1,0 +1,135 @@
+"""Remaining op-group tests: tensor-array, conv variants, misc math.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{array_read_write,
+conv_shift,row_conv,maxout,spp,prelu,bilinear_tensor_product,clip_by_norm,
+norm,sign,minus}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(41)
+
+
+def test_tensor_array_write_read_length():
+    arr = run_op('create_array', {}, {
+        'capacity': 4, 'elem_shape': [2, 3],
+        'elem_dtype': 'float32'})['Out'][0]
+    assert np.asarray(arr.data).shape == (4, 2, 3)
+    v = rng.randn(2, 3).astype('float32')
+    i = np.array([1], dtype='int64')
+    arr2 = run_op('write_to_array',
+                  {'Array': [arr], 'V': v, 'I': i})['Out'][0]
+    np.testing.assert_allclose(np.asarray(arr2.data)[1], v, rtol=1e-6)
+    assert np.all(np.asarray(arr2.data)[0] == 0)
+    back = np.asarray(run_op('read_from_array',
+                             {'X': [arr2], 'I': i})['Out'][0])
+    np.testing.assert_allclose(back, v, rtol=1e-6)
+    # size tracks the highest written index + 1
+    ln = np.asarray(run_op('array_length', {'X': [arr2]})['Out'][0])
+    assert int(np.ravel(ln)[0]) == 2
+
+
+def test_conv_shift():
+    x = rng.randn(3, 6).astype('float32')
+    y = rng.randn(3, 3).astype('float32')
+    got = np.asarray(run_op('conv_shift', {'X': x, 'Y': y})['Out'][0])
+    # circular correlation: out[i] = sum_j y[j] * x[(i + j - M//2) mod N]
+    B, N = x.shape
+    M = y.shape[1]
+    want = np.zeros_like(x)
+    for b in range(B):
+        for i in range(N):
+            for j in range(M):
+                want[b, i] += y[b, j] * x[b, (i + j - M // 2) % N]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv():
+    B, T, D, W = 2, 5, 3, 2
+    x = rng.randn(B, T, D).astype('float32')
+    w = rng.randn(W, D).astype('float32')
+    lengths = np.array([5, 3], dtype='int64')
+    for b in range(B):  # LoD convention: padded tail is zero
+        x[b, lengths[b]:] = 0
+    got = np.asarray(run_op('row_conv', {'X': x, 'Filter': w})['Out'][0])
+    # lookahead conv: out[t] = sum_{j<W, t+j < len} w[j] * x[t+j]
+    for b in range(B):
+        ln = int(lengths[b])
+        for t in range(ln):
+            want = np.zeros(D, 'float32')
+            for j in range(W):
+                if t + j < ln:
+                    want += w[j] * x[b, t + j]
+            np.testing.assert_allclose(got[b, t], want, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_maxout():
+    x = rng.randn(2, 6, 3, 3).astype('float32')
+    got = np.asarray(run_op('maxout', {'X': x}, {'groups': 2})['Out'][0])
+    want = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_spp():
+    x = rng.randn(1, 2, 8, 8).astype('float32')
+    got = np.asarray(run_op('spp', {'X': x},
+                            {'pyramid_height': 2})['Out'][0])
+    # levels: 1x1 + 2x2 bins, each C channels → C*(1+4)
+    assert got.shape == (1, 2 * 5)
+    np.testing.assert_allclose(got[0, :2], x.max(axis=(2, 3))[0],
+                               rtol=1e-5)
+
+
+def test_prelu():
+    x = rng.randn(3, 4).astype('float32')
+    alpha = np.array([0.25], dtype='float32')
+    got = np.asarray(run_op('prelu', {'X': x, 'Alpha': alpha})['Out'][0])
+    want = np.where(x > 0, x, 0.25 * x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    B, M, N, K = 2, 3, 4, 5
+    x = rng.randn(B, M).astype('float32')
+    y = rng.randn(B, N).astype('float32')
+    w = rng.randn(K, M, N).astype('float32')
+    got = np.asarray(run_op('bilinear_tensor_product',
+                            {'X': x, 'Y': y, 'Weight': w})['Out'][0])
+    want = np.einsum('bm,kmn,bn->bk', x, w, y)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_clip_by_norm():
+    x = rng.randn(4, 4).astype('float32') * 10
+    got = np.asarray(run_op('clip_by_norm', {'X': x},
+                            {'max_norm': 1.0})['Out'][0])
+    norm = np.sqrt((x ** 2).sum())
+    np.testing.assert_allclose(got, x / norm, rtol=1e-4, atol=1e-5)
+    small = rng.randn(2, 2).astype('float32') * 0.01
+    got2 = np.asarray(run_op('clip_by_norm', {'X': small},
+                             {'max_norm': 1.0})['Out'][0])
+    np.testing.assert_allclose(got2, small, rtol=1e-5)
+
+
+def test_norm_sign_minus():
+    x = rng.randn(3, 4).astype('float32')
+    # norm op L2-normalizes along axis (operators/norm_op)
+    n = np.asarray(run_op('norm', {'X': x})['Out'][0])
+    want = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(n, want, rtol=1e-4, atol=1e-5)
+    s = np.asarray(run_op('sign', {'X': x})['Out'][0])
+    np.testing.assert_array_equal(s, np.sign(x))
+    y = rng.randn(3, 4).astype('float32')
+    m = np.asarray(run_op('minus', {'X': x, 'Y': y})['Out'][0])
+    np.testing.assert_allclose(m, x - y, rtol=1e-5)
+
+
+def test_is_empty_and_get_places():
+    empty = np.zeros((0, 3), 'float32')
+    got = np.asarray(run_op('is_empty', {'X': empty})['Out'][0])
+    assert bool(np.ravel(got)[0])
+    full = np.zeros((2, 3), 'float32')
+    got2 = np.asarray(run_op('is_empty', {'X': full})['Out'][0])
+    assert not bool(np.ravel(got2)[0])

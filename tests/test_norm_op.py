@@ -1,0 +1,113 @@
+"""Normalization op tests vs numpy references.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{batch_norm,layer_norm,
+lrn,l1_norm,squared_l2_norm,squared_l2_distance}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(17)
+
+
+def test_batch_norm_train_nchw():
+    x = rng.randn(4, 3, 2, 2).astype('float32')
+    scale = rng.rand(3).astype('float32') + 0.5
+    bias = rng.randn(3).astype('float32')
+    mean = np.zeros(3, 'float32')
+    var = np.ones(3, 'float32')
+    outs = run_op('batch_norm',
+                  {'X': x, 'Scale': scale, 'Bias': bias, 'Mean': mean,
+                   'Variance': var}, {'epsilon': 1e-5, 'momentum': 0.9})
+    mu = x.mean(axis=(0, 2, 3))
+    v = x.var(axis=(0, 2, 3))
+    want = (x - mu[None, :, None, None]) / \
+        np.sqrt(v + 1e-5)[None, :, None, None] * \
+        scale[None, :, None, None] + bias[None, :, None, None]
+    np.testing.assert_allclose(np.asarray(outs['Y'][0]), want, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(outs['MeanOut'][0]),
+                               0.9 * mean + 0.1 * mu, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs['SavedMean'][0]), mu,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_test_mode_uses_running_stats():
+    x = rng.randn(4, 3).astype('float32')
+    scale = np.ones(3, 'float32')
+    bias = np.zeros(3, 'float32')
+    mean = rng.randn(3).astype('float32')
+    var = np.abs(rng.randn(3)).astype('float32') + 0.5
+    outs = run_op('batch_norm',
+                  {'X': x, 'Scale': scale, 'Bias': bias, 'Mean': mean,
+                   'Variance': var}, {'is_test': True, 'epsilon': 1e-5})
+    want = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(outs['Y'][0]), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_batch_norm_nhwc():
+    x = rng.randn(2, 4, 4, 5).astype('float32')
+    scale = np.ones(5, 'float32')
+    bias = np.zeros(5, 'float32')
+    outs = run_op('batch_norm',
+                  {'X': x, 'Scale': scale, 'Bias': bias,
+                   'Mean': np.zeros(5, 'float32'),
+                   'Variance': np.ones(5, 'float32')},
+                  {'data_layout': 'NHWC'})
+    mu = x.mean(axis=(0, 1, 2))
+    v = x.var(axis=(0, 1, 2))
+    want = (x - mu) / np.sqrt(v + 1e-5)
+    np.testing.assert_allclose(np.asarray(outs['Y'][0]), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_layer_norm():
+    x = rng.randn(3, 4, 5).astype('float32')
+    scale = rng.rand(4, 5).astype('float32') + 0.5
+    bias = rng.randn(4, 5).astype('float32')
+    outs = run_op('layer_norm', {'X': x, 'Scale': scale, 'Bias': bias},
+                  {'begin_norm_axis': 1, 'epsilon': 1e-5})
+    mu = x.reshape(3, -1).mean(axis=1)
+    v = x.reshape(3, -1).var(axis=1)
+    want = (x - mu[:, None, None]) / np.sqrt(v + 1e-5)[:, None, None] * \
+        scale[None] + bias[None]
+    np.testing.assert_allclose(np.asarray(outs['Y'][0]), want, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(outs['Mean'][0]), mu, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lrn():
+    x = rng.randn(2, 6, 3, 3).astype('float32')
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    got = np.asarray(run_op('lrn', {'X': x},
+                            {'n': n, 'k': k, 'alpha': alpha,
+                             'beta': beta})['Out'][0])
+    want = np.empty_like(x)
+    C = x.shape[1]
+    half = n // 2
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + n - half)
+        acc = (x[:, lo:hi] ** 2).sum(axis=1)
+        want[:, c] = x[:, c] / (k + alpha * acc) ** beta
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_l1_and_squared_l2_norm():
+    x = rng.randn(4, 5).astype('float32')
+    l1 = np.asarray(run_op('l1_norm', {'X': x})['Out'][0])
+    np.testing.assert_allclose(float(np.ravel(l1)[0]), np.abs(x).sum(),
+                               rtol=1e-4)
+    sq = np.asarray(run_op('squared_l2_norm', {'X': x})['Out'][0])
+    np.testing.assert_allclose(float(np.ravel(sq)[0]), (x ** 2).sum(),
+                               rtol=1e-4)
+
+
+def test_squared_l2_distance():
+    x = rng.randn(4, 5).astype('float32')
+    y = rng.randn(4, 5).astype('float32')
+    outs = run_op('squared_l2_distance', {'X': x, 'Y': y})
+    want = ((x - y) ** 2).sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(outs['Out'][0]), want,
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,58 @@
+"""Random op statistical tests.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{uniform_random,
+gaussian_random,dropout}_op.py — moments and bounds, not exact values.
+"""
+import numpy as np
+
+from op_test import run_op
+
+
+def test_uniform_random():
+    got = np.asarray(run_op('uniform_random', {}, {
+        'shape': [2000], 'min': -2.0, 'max': 3.0})['Out'][0])
+    assert got.shape == (2000,)
+    assert got.min() >= -2.0 and got.max() <= 3.0
+    np.testing.assert_allclose(got.mean(), 0.5, atol=0.15)
+
+
+def test_gaussian_random():
+    got = np.asarray(run_op('gaussian_random', {}, {
+        'shape': [4000], 'mean': 1.0, 'std': 2.0})['Out'][0])
+    np.testing.assert_allclose(got.mean(), 1.0, atol=0.15)
+    np.testing.assert_allclose(got.std(), 2.0, atol=0.15)
+
+
+def test_truncated_gaussian_random():
+    got = np.asarray(run_op('truncated_gaussian_random', {}, {
+        'shape': [4000], 'mean': 0.0, 'std': 1.0})['Out'][0])
+    assert np.abs(got).max() <= 2.0 + 1e-5  # truncated at 2 std
+
+
+def test_dropout_train_mask_and_scale():
+    x = np.ones((100, 100), 'float32')
+    outs = run_op('dropout', {'X': x}, {'dropout_prob': 0.3})
+    y = np.asarray(outs['Out'][0])
+    mask = np.asarray(outs['Mask'][0])
+    # reference semantics: Out = X * Mask (values stay 1, no rescale)
+    assert set(np.unique(y)) <= {0.0, 1.0}
+    np.testing.assert_allclose((y == 0).mean(), 0.3, atol=0.05)
+    np.testing.assert_allclose(y, x * mask, rtol=1e-6)
+
+
+def test_dropout_is_test_scales():
+    x = np.ones((10, 10), 'float32')
+    y = np.asarray(run_op('dropout', {'X': x}, {
+        'dropout_prob': 0.4, 'is_test': True})['Out'][0])
+    np.testing.assert_allclose(y, x * 0.6, rtol=1e-6)
+
+
+def test_random_crop():
+    x = np.arange(100, dtype='float32').reshape(1, 10, 10)
+    got = np.asarray(run_op('random_crop', {'X': x},
+                            {'shape': [5, 5]})['Out'][0])
+    assert got.shape == (1, 5, 5)
+    # crop must be a contiguous window: row deltas of 1 within rows
+    flat = got[0]
+    assert np.all(np.diff(flat, axis=1) == 1)
+    assert np.all(np.diff(flat[:, 0]) == 10)

@@ -1,0 +1,105 @@
+"""Recurrent op tests vs step-by-step numpy recurrences.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{lstm,lstm_unit,gru,
+gru_unit}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(13)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_matches_numpy_recurrence():
+    B, T, H = 3, 5, 4
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = rng.randn(H, 4 * H).astype('float32') * 0.5
+    bias = rng.randn(1, 4 * H).astype('float32') * 0.1
+    lengths = np.array([5, 3, 4], dtype='int64')
+    outs = run_op('lstm', {'Input': x, 'Weight': w, 'Bias': bias,
+                           'XLen': lengths}, {'use_peepholes': False})
+    hs = np.asarray(outs['Hidden'][0])
+    cs = np.asarray(outs['Cell'][0])
+
+    for b in range(B):
+        h = np.zeros(H)
+        c = np.zeros(H)
+        for t in range(int(lengths[b])):
+            g = x[b, t] + bias[0] + h @ w
+            gi, gf, gc, go = np.split(g, 4)
+            i, f, o = _sigmoid(gi), _sigmoid(gf), _sigmoid(go)
+            c = f * c + i * np.tanh(gc)
+            h = o * np.tanh(c)
+            np.testing.assert_allclose(hs[b, t], h, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(cs[b, t], c, rtol=1e-4, atol=1e-5)
+        assert np.all(hs[b, int(lengths[b]):] == 0)
+
+
+def test_lstm_reverse_direction():
+    B, T, H = 2, 4, 3
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = rng.randn(H, 4 * H).astype('float32') * 0.5
+    lengths = np.array([4, 4], dtype='int64')
+    fwd = np.asarray(run_op(
+        'lstm', {'Input': x[:, ::-1].copy(), 'Weight': w, 'XLen': lengths},
+        {'use_peepholes': False})['Hidden'][0])
+    rev = np.asarray(run_op(
+        'lstm', {'Input': x, 'Weight': w, 'XLen': lengths},
+        {'use_peepholes': False, 'is_reverse': True})['Hidden'][0])
+    # reverse LSTM over x == forward LSTM over reversed x, re-reversed
+    np.testing.assert_allclose(rev, fwd[:, ::-1], rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_unit():
+    B, H = 4, 3
+    x = rng.randn(B, 4 * H).astype('float32')
+    c_prev = rng.randn(B, H).astype('float32')
+    outs = run_op('lstm_unit', {'X': x, 'C_prev': c_prev},
+                  {'forget_bias': 0.5})
+    i, f, o, j = np.split(x, 4, axis=1)
+    c = _sigmoid(f + 0.5) * c_prev + _sigmoid(i) * np.tanh(j)
+    h = _sigmoid(o) * np.tanh(c)
+    np.testing.assert_allclose(np.asarray(outs['C'][0]), c, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs['H'][0]), h, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_matches_numpy_recurrence():
+    B, T, H = 3, 4, 3
+    x = rng.randn(B, T, 3 * H).astype('float32')
+    w = rng.randn(H, 3 * H).astype('float32') * 0.5
+    lengths = np.array([4, 2, 3], dtype='int64')
+    outs = run_op('gru', {'Input': x, 'Weight': w, 'XLen': lengths})
+    hs = np.asarray(outs['Hidden'][0])
+    w_rz, w_c = w[:, :2 * H], w[:, 2 * H:]
+    for b in range(B):
+        h = np.zeros(H)
+        for t in range(int(lengths[b])):
+            rz = x[b, t, :2 * H] + h @ w_rz
+            u = _sigmoid(rz[:H])
+            r = _sigmoid(rz[H:])
+            c = np.tanh(x[b, t, 2 * H:] + (r * h) @ w_c)
+            h = u * h + (1 - u) * c
+            np.testing.assert_allclose(hs[b, t], h, rtol=1e-4, atol=1e-5)
+        assert np.all(hs[b, int(lengths[b]):] == 0)
+
+
+def test_gru_unit():
+    B, H = 3, 4
+    x = rng.randn(B, 3 * H).astype('float32')
+    h_p = rng.randn(B, H).astype('float32')
+    w = rng.randn(H, 3 * H).astype('float32') * 0.5
+    outs = run_op('gru_unit',
+                  {'Input': x, 'HiddenPrev': h_p, 'Weight': w})
+    rz = x[:, :2 * H] + h_p @ w[:, :2 * H]
+    u = _sigmoid(rz[:, :H])
+    r = _sigmoid(rz[:, H:])
+    c = np.tanh(x[:, 2 * H:] + (r * h_p) @ w[:, 2 * H:])
+    want = u * h_p + (1 - u) * c
+    np.testing.assert_allclose(np.asarray(outs['Hidden'][0]), want,
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,191 @@
+"""SelectedRows sparse-gradient path tests (C5/O11).
+
+Reference parity: paddle/operators/lookup_table_op.cc:52 (SelectedRows
+grad), sgd_op.cc / adagrad_op.cc sparse branches, framework/
+selected_rows.h.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core.selected_rows import (SelectedRows,
+                                           merge_duplicate_rows)
+from op_test import run_op
+
+rng = np.random.RandomState(23)
+
+
+def test_merge_duplicate_rows():
+    rows = jnp.asarray([3, 1, 3, 0], jnp.int32)
+    vals = jnp.asarray(rng.randn(4, 2), jnp.float32)
+    mrows, mvals, valid = merge_duplicate_rows(rows, vals)
+    assert int(valid.sum()) == 3
+    got = {int(r): np.asarray(v) for r, v, ok in
+           zip(mrows, mvals, valid) if bool(ok)}
+    np.testing.assert_allclose(got[0], np.asarray(vals[3]), rtol=1e-6)
+    np.testing.assert_allclose(got[1], np.asarray(vals[1]), rtol=1e-6)
+    np.testing.assert_allclose(got[3], np.asarray(vals[0] + vals[2]),
+                               rtol=1e-6)
+
+
+def test_sparse_grad_assemble_op():
+    ids = np.array([[1], [4], [1]], dtype='int64')
+    g = rng.randn(3, 5).astype('float32')
+    sr = run_op('sparse_grad_assemble',
+                {'Ids': [ids], 'OutGrad': [g]}, {'height': 10})['Out'][0]
+    assert isinstance(sr, SelectedRows)
+    assert sr.height == 10
+    np.testing.assert_array_equal(np.asarray(sr.rows), [1, 4, 1])
+    dense = np.asarray(sr.to_dense())
+    want = np.zeros((10, 5), 'float32')
+    np.add.at(want, [1, 4, 1], g)
+    np.testing.assert_allclose(dense, want, rtol=1e-5, atol=1e-6)
+
+
+def _train_once(is_sparse, optimizer, steps=3):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 42
+    startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name='words', shape=[4], dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1], dtype='float32')
+        emb = fluid.layers.embedding(
+            input=words, size=[50, 8], is_sparse=is_sparse,
+            param_attr=fluid.ParamAttr(
+                name='emb_w',
+                initializer=fluid.initializer.NormalInitializer(seed=7)))
+        pooled = fluid.layers.sequence_pool(input=emb, pool_type='sum')
+        pred = fluid.layers.fc(
+            input=pooled, size=1, act=None,
+            param_attr=fluid.ParamAttr(
+                name='fc_w',
+                initializer=fluid.initializer.NormalInitializer(seed=9)))
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=label))
+        optimizer().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(3)
+    for _ in range(steps):
+        feed = {'words': r.randint(0, 50, (6, 4)).astype('int64'),
+                'label': r.randn(6, 1).astype('float32')}
+        exe.run(main, feed=feed, fetch_list=[loss])
+    return np.asarray(fluid.global_scope().find_var('emb_w'))
+
+
+def test_sparse_sgd_matches_dense():
+    dense = _train_once(False,
+                        lambda: fluid.optimizer.SGDOptimizer(0.1))
+    fluid.global_scope().clear() if hasattr(fluid.global_scope(), 'clear') \
+        else None
+    sparse = _train_once(True,
+                         lambda: fluid.optimizer.SGDOptimizer(0.1))
+    np.testing.assert_allclose(sparse, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_adagrad_matches_dense_on_touched_rows():
+    """Sparse adagrad only accumulates on touched rows; dense adagrad adds
+    g^2=0 there too — identical numerics everywhere."""
+    dense = _train_once(
+        False, lambda: fluid.optimizer.AdagradOptimizer(0.1))
+    sparse = _train_once(
+        True, lambda: fluid.optimizer.AdagradOptimizer(0.1))
+    np.testing.assert_allclose(sparse, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_adam_first_step_matches_dense():
+    """From zero moments one lazy-adam step equals dense adam (untouched
+    rows have m=v=0 -> zero step)."""
+    dense = _train_once(
+        False, lambda: fluid.optimizer.AdamOptimizer(0.05), steps=1)
+    sparse = _train_once(
+        True, lambda: fluid.optimizer.AdamOptimizer(0.05), steps=1)
+    np.testing.assert_allclose(sparse, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_with_regularizer_falls_back_to_dense():
+    """A regularized embedding appends elementwise ops over the grad var,
+    so it must keep the dense path (no SelectedRows crash)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name='words', shape=[1], dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1], dtype='float32')
+        emb = fluid.layers.embedding(
+            input=words, size=[30, 4], is_sparse=True,
+            param_attr=fluid.ParamAttr(
+                name='reg_w',
+                regularizer=fluid.regularizer.L2Decay(1e-4)))
+        pred = fluid.layers.fc(input=emb, size=1, act=None)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=label))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    assert not any(op.type == 'sparse_grad_assemble'
+                   for op in main.global_block().ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main, feed={'words': np.array([[3]], 'int64'),
+                              'label': np.ones((1, 1), 'float32')},
+                  fetch_list=[loss])
+    assert np.isfinite(np.ravel(out[0])[0])
+
+
+def test_padding_idx_never_touches_real_rows():
+    """Lazy sparse adam with padding ids must leave every row that was not
+    actually looked up untouched (the pad grads land on the pad row with
+    zero values, not on row 0)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 31
+    startup.random_seed = 31
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name='words', shape=[1], dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1], dtype='float32')
+        emb = fluid.layers.embedding(input=words, size=[20, 4],
+                                    is_sparse=True, padding_idx=5,
+                                    param_attr='pad_w')
+        pred = fluid.layers.fc(input=emb, size=1, act=None)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=label))
+        fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    before = np.asarray(fluid.global_scope().find_var('pad_w')).copy()
+    # batch: rows 7 and the padding id 5
+    exe.run(main, feed={'words': np.array([[7], [5]], 'int64'),
+                        'label': np.ones((2, 1), 'float32')},
+            fetch_list=[loss])
+    after = np.asarray(fluid.global_scope().find_var('pad_w'))
+    changed = ~np.all(np.isclose(before, after, atol=1e-8), axis=1)
+    touched = set(np.nonzero(changed)[0].tolist())
+    assert 7 in touched
+    assert 0 not in touched  # row 0 must not move
+    assert touched <= {5, 7}  # at most the looked-up row and the pad row
+
+
+def test_grad_var_is_selected_rows():
+    """The vocab-height dense grad never materializes: fetching the grad
+    var yields a SelectedRows whose rows are exactly the fed ids."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data(name='words', shape=[1], dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1], dtype='float32')
+        emb = fluid.layers.embedding(input=words, size=[100, 4],
+                                    is_sparse=True,
+                                    param_attr='sr_w')
+        pred = fluid.layers.fc(input=emb, size=1, act=None)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=label))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {'words': np.array([[7], [3], [7]], 'int64'),
+            'label': np.ones((3, 1), 'float32')}
+    out = exe.run(main, feed=feed, fetch_list=['sr_w@GRAD'],
+                  return_numpy=False)[0]
+    assert isinstance(out, SelectedRows)
+    assert out.height == 100
+    np.testing.assert_array_equal(np.sort(np.asarray(out.rows)),
+                                  [3, 7, 7])

@@ -1,0 +1,147 @@
+"""Sequence (LoD) op tests on the padded+lengths representation.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{seq_pool,
+sequence_softmax,seq_conv,sequence_expand,seq_concat,sequence_slice,
+sequence_erase,lod_reset}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(21)
+
+
+def test_sequence_pool_all_types():
+    B, T, D = 3, 4, 2
+    x = rng.randn(B, T, D).astype('float32')
+    lengths = np.array([4, 2, 3], dtype='int64')
+    m = [x[b, :lengths[b]] for b in range(B)]
+    cases = {
+        'SUM': np.stack([v.sum(0) for v in m]),
+        'AVERAGE': np.stack([v.mean(0) for v in m]),
+        'SQRT': np.stack([v.sum(0) / np.sqrt(len(v)) for v in m]),
+        'MAX': np.stack([v.max(0) for v in m]),
+        'LAST': np.stack([v[-1] for v in m]),
+        'FIRST': np.stack([v[0] for v in m]),
+    }
+    for ptype, want in cases.items():
+        got = np.asarray(run_op(
+            'sequence_pool', {'X': x, 'XLen': lengths},
+            {'pooltype': ptype})['Out'][0])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=ptype)
+
+
+def test_sequence_first_last_step():
+    B, T, D = 2, 3, 2
+    x = rng.randn(B, T, D).astype('float32')
+    lengths = np.array([3, 2], dtype='int64')
+    first = np.asarray(run_op('sequence_first_step',
+                              {'X': x, 'XLen': lengths})['Out'][0])
+    last = np.asarray(run_op('sequence_last_step',
+                             {'X': x, 'XLen': lengths})['Out'][0])
+    np.testing.assert_allclose(first, x[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(last, np.stack([x[0, 2], x[1, 1]]),
+                               rtol=1e-5)
+
+
+def test_sequence_softmax():
+    B, T = 2, 4
+    x = rng.randn(B, T).astype('float32')
+    lengths = np.array([4, 2], dtype='int64')
+    got = np.asarray(run_op('sequence_softmax',
+                            {'X': x, 'XLen': lengths})['Out'][0])
+    for b in range(B):
+        ln = int(lengths[b])
+        e = np.exp(x[b, :ln] - x[b, :ln].max())
+        np.testing.assert_allclose(got[b, :ln], e / e.sum(), rtol=1e-4,
+                                   atol=1e-5)
+        assert np.all(got[b, ln:] == 0)
+
+
+def test_sequence_conv():
+    B, T, D, M = 2, 4, 3, 5
+    ctx_len = 3
+    x = rng.randn(B, T, D).astype('float32')
+    w = rng.randn(ctx_len * D, M).astype('float32')
+    lengths = np.array([4, 3], dtype='int64')
+    got = np.asarray(run_op(
+        'sequence_conv', {'X': x, 'Filter': w, 'XLen': lengths},
+        {'contextLength': ctx_len, 'contextStart': -1})['Out'][0])
+    for b in range(B):
+        ln = int(lengths[b])
+        for t in range(ln):
+            frames = []
+            for k in range(ctx_len):
+                src = t - 1 + k
+                if 0 <= src < ln:
+                    frames.append(x[b, src])
+                else:
+                    frames.append(np.zeros(D, 'float32'))
+            want = np.concatenate(frames) @ w
+            np.testing.assert_allclose(got[b, t], want, rtol=1e-4,
+                                       atol=1e-5)
+        assert np.all(got[b, ln:] == 0)
+
+
+def test_sequence_expand():
+    x = rng.randn(2, 3).astype('float32')
+    y = np.zeros((2, 4, 1), 'float32')
+    ylen = np.array([4, 2], dtype='int64')
+    got = np.asarray(run_op('sequence_expand',
+                            {'X': x, 'Y': y, 'YLen': ylen})['Out'][0])
+    assert got.shape == (2, 4, 3)
+    for t in range(4):
+        np.testing.assert_allclose(got[0, t], x[0], rtol=1e-6)
+    np.testing.assert_allclose(got[1, 0], x[1], rtol=1e-6)
+    assert np.all(got[1, 2:] == 0)
+
+
+def test_sequence_concat():
+    a = rng.randn(2, 3, 2).astype('float32')
+    b = rng.randn(2, 2, 2).astype('float32')
+    alen = np.array([2, 3], dtype='int64')
+    blen = np.array([2, 1], dtype='int64')
+    outs = run_op('sequence_concat',
+                  {'X': [a, b], 'XLen': [alen, blen]})
+    got = np.asarray(outs['Out'][0])
+    got_len = np.asarray(outs['OutLen'][0])
+    np.testing.assert_array_equal(got_len, [4, 4])
+    np.testing.assert_allclose(got[0, :2], a[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(got[0, 2:4], b[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(got[1, :3], a[1, :3], rtol=1e-6)
+    np.testing.assert_allclose(got[1, 3:4], b[1, :1], rtol=1e-6)
+
+
+def test_sequence_slice():
+    x = rng.randn(2, 5, 2).astype('float32')
+    offset = np.array([1, 0], dtype='int64')
+    length = np.array([2, 3], dtype='int64')
+    outs = run_op('sequence_slice',
+                  {'X': x, 'Offset': offset, 'Length': length},
+                  {'max_length': 3})
+    got = np.asarray(outs['Out'][0])
+    np.testing.assert_allclose(got[0, :2], x[0, 1:3], rtol=1e-6)
+    assert np.all(got[0, 2:] == 0)
+    np.testing.assert_allclose(got[1, :3], x[1, :3], rtol=1e-6)
+
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 3, 1, 5], [1, 1, 2, 0, 0]], dtype='int64')
+    lengths = np.array([5, 3], dtype='int64')
+    outs = run_op('sequence_erase', {'X': x, 'XLen': lengths},
+                  {'tokens': [1]})
+    got = np.asarray(outs['Out'][0])
+    got_len = np.asarray(outs['OutLen'][0])
+    np.testing.assert_array_equal(got_len, [3, 1])
+    np.testing.assert_array_equal(got[0, :3], [2, 3, 5])
+    np.testing.assert_array_equal(got[1, :1], [2])
+    assert np.all(got[0, 3:] == 0) and np.all(got[1, 1:] == 0)
+
+
+def test_lod_reset():
+    x = rng.randn(3, 4).astype('float32')
+    target = np.array([2, 4, 1], dtype='int64')
+    outs = run_op('lod_reset', {'X': x}, {'target_lod': [2, 4, 1]})
+    np.testing.assert_allclose(np.asarray(outs['Out'][0]), x, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(outs['OutLen'][0]), target)

@@ -1,0 +1,189 @@
+"""Tensor-manipulation op tests vs numpy.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{reshape,transpose,
+concat,split,expand,pad,crop,cast,gather,scatter,multiplex,one_hot,top_k,
+increment,fill_*,compare,logical}_op.py and test_reduce_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(31)
+
+
+def test_reshape_zero_and_minus_one():
+    x = rng.randn(2, 3, 4).astype('float32')
+    got = np.asarray(run_op('reshape', {'X': x},
+                            {'shape': [0, -1]})['Out'][0])
+    assert got.shape == (2, 12)
+    np.testing.assert_allclose(got, x.reshape(2, 12), rtol=1e-6)
+
+
+def test_transpose():
+    x = rng.randn(2, 3, 4).astype('float32')
+    got = np.asarray(run_op('transpose', {'X': x},
+                            {'axis': [2, 0, 1]})['Out'][0])
+    np.testing.assert_allclose(got, x.transpose(2, 0, 1), rtol=1e-6)
+
+
+def test_concat_and_split():
+    a = rng.randn(2, 3).astype('float32')
+    b = rng.randn(2, 5).astype('float32')
+    got = np.asarray(run_op('concat', {'X': [a, b]},
+                            {'axis': 1})['Out'][0])
+    np.testing.assert_allclose(got, np.concatenate([a, b], axis=1),
+                               rtol=1e-6)
+    pieces = run_op('split', {'X': got}, {'axis': 1,
+                                          'sections': [3, 5]})['Out']
+    np.testing.assert_allclose(np.asarray(pieces[0]), a, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pieces[1]), b, rtol=1e-6)
+
+
+def test_expand_pad_crop():
+    x = rng.randn(2, 3).astype('float32')
+    got = np.asarray(run_op('expand', {'X': x},
+                            {'expand_times': [2, 3]})['Out'][0])
+    np.testing.assert_allclose(got, np.tile(x, (2, 3)), rtol=1e-6)
+    padded = np.asarray(run_op('pad', {'X': x},
+                               {'paddings': [1, 0, 0, 2],
+                                'pad_value': 7.0})['Out'][0])
+    want = np.pad(x, [(1, 0), (0, 2)], constant_values=7.0)
+    np.testing.assert_allclose(padded, want, rtol=1e-6)
+    cropped = np.asarray(run_op('crop', {'X': padded},
+                                {'offsets': [1, 0],
+                                 'shape': [2, 3]})['Out'][0])
+    np.testing.assert_allclose(cropped, x, rtol=1e-6)
+
+
+def test_cast():
+    x = rng.randn(3, 2).astype('float32') * 3
+    got = np.asarray(run_op('cast', {'X': x},
+                            {'out_dtype': 'int32'})['Out'][0])
+    np.testing.assert_array_equal(got, x.astype('int32'))
+
+
+def test_gather_scatter():
+    x = rng.randn(5, 3).astype('float32')
+    idx = np.array([3, 0, 3], dtype='int64')
+    got = np.asarray(run_op('gather', {'X': x, 'Index': idx})['Out'][0])
+    np.testing.assert_allclose(got, x[idx], rtol=1e-6)
+    upd = rng.randn(2, 3).astype('float32')
+    got2 = np.asarray(run_op('scatter',
+                             {'X': x, 'Ids': np.array([1, 4], 'int64'),
+                              'Updates': upd})['Out'][0])
+    want = x.copy()
+    want[[1, 4]] = upd
+    np.testing.assert_allclose(got2, want, rtol=1e-6)
+
+
+def test_multiplex():
+    a = rng.randn(4, 3).astype('float32')
+    b = rng.randn(4, 3).astype('float32')
+    ids = np.array([0, 1, 1, 0], dtype='int64')
+    got = np.asarray(run_op('multiplex',
+                            {'X': [a, b], 'Ids': ids})['Out'][0])
+    want = np.where((ids == 0)[:, None], a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_one_hot():
+    x = np.array([[1], [0], [3]], dtype='int64')
+    got = np.asarray(run_op('one_hot', {'X': x}, {'depth': 4})['Out'][0])
+    want = np.eye(4, dtype='float32')[[1, 0, 3]]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_top_k():
+    x = rng.randn(3, 6).astype('float32')
+    outs = run_op('top_k', {'X': x}, {'k': 2})
+    vals = np.asarray(outs['Out'][0])
+    idx = np.asarray(outs['Indices'][0])
+    want_idx = np.argsort(-x, axis=1)[:, :2]
+    np.testing.assert_array_equal(idx, want_idx)
+    np.testing.assert_allclose(vals, np.take_along_axis(x, want_idx, 1),
+                               rtol=1e-6)
+
+
+def test_increment_and_fills():
+    x = np.array([1.5], dtype='float32')
+    got = np.asarray(run_op('increment', {'X': x},
+                            {'step': 2.0})['Out'][0])
+    np.testing.assert_allclose(got, [3.5], rtol=1e-6)
+    fc = np.asarray(run_op('fill_constant', {}, {
+        'shape': [2, 3], 'value': 4.5, 'dtype': 'float32'})['Out'][0])
+    np.testing.assert_allclose(fc, np.full((2, 3), 4.5), rtol=1e-6)
+    fz = np.asarray(run_op('fill_zeros_like',
+                           {'X': rng.randn(2, 2).astype('float32')}
+                           )['Out'][0])
+    np.testing.assert_allclose(fz, np.zeros((2, 2)), rtol=1e-6)
+    ref = np.zeros((7, 2), 'float32')
+    fb = np.asarray(run_op('fill_constant_batch_size_like', {'Input': ref},
+                           {'shape': [1, 5], 'value': 2.0,
+                            'dtype': 'float32'})['Out'][0])
+    assert fb.shape == (7, 5)
+    np.testing.assert_allclose(fb, np.full((7, 5), 2.0), rtol=1e-6)
+
+
+def test_compare_ops():
+    x = np.array([1, 2, 3], dtype='float32')
+    y = np.array([2, 2, 2], dtype='float32')
+    cases = {'less_than': x < y, 'less_equal': x <= y,
+             'greater_than': x > y, 'greater_equal': x >= y,
+             'equal': x == y, 'not_equal': x != y}
+    for op, want in cases.items():
+        got = np.asarray(run_op(op, {'X': x, 'Y': y})['Out'][0])
+        np.testing.assert_array_equal(got, want, err_msg=op)
+
+
+def test_logical_ops():
+    x = np.array([True, True, False])
+    y = np.array([True, False, False])
+    np.testing.assert_array_equal(
+        np.asarray(run_op('logical_and', {'X': x, 'Y': y})['Out'][0]),
+        x & y)
+    np.testing.assert_array_equal(
+        np.asarray(run_op('logical_or', {'X': x, 'Y': y})['Out'][0]),
+        x | y)
+    np.testing.assert_array_equal(
+        np.asarray(run_op('logical_xor', {'X': x, 'Y': y})['Out'][0]),
+        x ^ y)
+    np.testing.assert_array_equal(
+        np.asarray(run_op('logical_not', {'X': x})['Out'][0]), ~x)
+
+
+def test_reduce_ops():
+    x = rng.randn(3, 4).astype('float32')
+    for op, ref in [('reduce_sum', np.sum), ('reduce_mean', np.mean),
+                    ('reduce_max', np.max), ('reduce_min', np.min)]:
+        got = np.asarray(run_op(op, {'X': x}, {'dim': 1,
+                                               'keep_dim': False})['Out'][0])
+        np.testing.assert_allclose(got, ref(x, axis=1), rtol=1e-5,
+                                   atol=1e-6, err_msg=op)
+
+
+def test_sequence_reshape():
+    x = rng.randn(2, 4, 6).astype('float32')
+    got = np.asarray(run_op('sequence_reshape', {'X': x},
+                            {'new_dim': 8})['Out'][0])
+    assert got.shape == (2, 3, 8)
+    np.testing.assert_allclose(got, x.reshape(2, 3, 8), rtol=1e-6)
+
+
+def test_im2sequence():
+    x = rng.randn(1, 2, 4, 4).astype('float32')
+    got = np.asarray(run_op('im2sequence', {'X': x},
+                            {'kernels': [2, 2],
+                             'strides': [2, 2]})['Out'][0])
+    assert got.shape == (1, 4, 8)  # 2x2 patches, C*kh*kw = 8
+    # first patch spans x[:, :, :2, :2]
+    want0 = x[0, :, :2, :2].reshape(-1)
+    np.testing.assert_allclose(got[0, 0], want0, rtol=1e-5)
+
+
+def test_select():
+    cond = np.array([[True], [False]])
+    x = rng.randn(2, 1).astype('float32')
+    y = rng.randn(2, 1).astype('float32')
+    got = np.asarray(run_op('select',
+                            {'Condition': cond, 'X': x, 'Y': y})['Out'][0])
+    np.testing.assert_allclose(got, np.where(cond, x, y), rtol=1e-6)

@@ -1,0 +1,45 @@
+"""A6 — op documentation generator.
+
+Reference parity: the reference auto-generates op docs from each
+OpProtoAndCheckerMaker's AddComment (paddle/framework/op_registry +
+print_operators_doc).  Here the registry holds python impls whose
+docstrings play that role: this tool renders one markdown table of every
+registered op plus the per-module docs.
+
+Usage: python tools/gen_op_docs.py [out.md]
+"""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+
+def generate(out_path=None):
+    import paddle_tpu  # noqa: F401  (registers the op library)
+    from paddle_tpu.core.registry import _OP_REGISTRY
+
+    lines = ['# Operator reference', '',
+             '%d registered ops.  Grad comes from functional autodiff '
+             '(core/backward.py), not per-op grad kernels.' %
+             len(_OP_REGISTRY), '',
+             '| op | module | doc |', '|---|---|---|']
+    for name in sorted(_OP_REGISTRY):
+        impl = _OP_REGISTRY[name]
+        fn = getattr(impl, 'fn', None) or getattr(impl, 'compute', impl)
+        doc = (inspect.getdoc(fn) or '').split('\n')[0].strip()
+        mod = getattr(fn, '__module__', '?').replace('paddle_tpu.', '')
+        lines.append('| `%s` | %s | %s |' %
+                     (name, mod, doc.replace('|', '\\|')))
+    text = '\n'.join(lines) + '\n'
+    if out_path:
+        with open(out_path, 'w') as f:
+            f.write(text)
+    return text
+
+
+if __name__ == '__main__':
+    out = sys.argv[1] if len(sys.argv) > 1 else 'OP_DOCS.md'
+    generate(out)
+    print('wrote %s' % out)
