@@ -1,0 +1,3 @@
+from .serving import export_inference, load_exported, InferenceServer
+
+__all__ = ['export_inference', 'load_exported', 'InferenceServer']

@@ -1,0 +1,95 @@
+"""N4 — inference deployment: saved-HLO serving.
+
+Reference parity: paddle/capi exposes a C ABI that loads a serialized
+ProgramDesc + params and runs inference from any host language.  The
+TPU-native counterpart is `jax.export`: the whole pruned inference program
+(one XLA computation, params baked in as constants or passed as args)
+serializes to a portable StableHLO artifact that any process with XLA —
+C++, Python, another accelerator host — can load and run without this
+framework installed.
+"""
+import os
+
+import numpy as np
+
+import jax
+from jax import export as jax_export
+
+from ..core.executor import Executor
+from ..core.place import default_place
+from ..core.program import Variable, default_main_program
+from ..core.scope import global_scope
+
+__all__ = ['export_inference', 'load_exported', 'InferenceServer']
+
+
+def _example_args(program, feed_shapes):
+    block = program.global_block()
+    out = {}
+    for name, shape in feed_shapes.items():
+        var = block.vars.get(name)
+        dt = np.float32
+        if var is not None and 'int' in str(var.dtype):
+            dt = np.int32
+        out[name] = np.zeros(shape, dt)
+    return out
+
+
+def export_inference(path, feed_shapes, target_vars, executor=None,
+                     main_program=None, scope=None):
+    """Serialize the pruned inference computation to a StableHLO artifact.
+
+    :param feed_shapes: {feed_name: concrete shape} — exported programs
+        are shape-specialized (XLA static shapes).
+    :param target_vars: output Variables.
+    :returns: the serialized byte size.
+    """
+    if main_program is None:
+        main_program = default_main_program()
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    scope = scope or global_scope()
+    exe = executor or Executor(default_place())
+    pruned = main_program.prune(targets=target_vars,
+                                feeds=list(feed_shapes))
+    infer_prog = pruned.inference_optimize()
+    feed = _example_args(infer_prog, feed_shapes)
+    fn, args = exe.compile(infer_prog, feed=feed,
+                           fetch_list=target_vars, scope=scope)
+    feed_arrays, state_rw, state_ro, rng_key = args
+
+    def serve(feed_vals, rng_key):
+        fetches, _ = fn(feed_vals, state_rw, state_ro, rng_key)
+        return fetches
+
+    exported = jax_export.export(jax.jit(serve))(feed_arrays, rng_key)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with open(path, 'wb') as f:
+        f.write(blob)
+    return len(blob)
+
+
+def load_exported(path):
+    """Load a StableHLO artifact; returns fn({name: array}) -> [outputs].
+    Requires only jax/XLA — not the framework that exported it."""
+    with open(path, 'rb') as f:
+        exported = jax_export.deserialize(f.read())
+
+    def run(feed):
+        key = jax.random.PRNGKey(0)
+        return exported.call(feed, key)
+
+    return run
+
+
+class InferenceServer(object):
+    """Minimal in-process serving wrapper over an exported artifact
+    (capi-equivalent surface: load once, predict many)."""
+
+    def __init__(self, path):
+        self._fn = load_exported(path)
+
+    def predict(self, feed):
+        outs = self._fn({k: np.asarray(v) for k, v in feed.items()})
+        return [np.asarray(o) for o in outs]

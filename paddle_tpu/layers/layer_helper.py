@@ -205,8 +205,12 @@ class LayerHelper(object):
         bias_attr = self.bias_attr
         if not bias_attr:
             return input_var
+        # fp32 master bias under low-precision activations (the add
+        # upcasts/narrows at use; optimizer updates full precision)
+        b_dtype = 'float32' if str(input_var.dtype) in (
+            'bfloat16', 'float16') else input_var.dtype
         b = self.create_parameter(attr=bias_attr, shape=size,
-                                  dtype=input_var.dtype, is_bias=True)
+                                  dtype=b_dtype, is_bias=True)
         tmp = self.create_tmp_variable(dtype=input_var.dtype,
                                        lod_level=input_var.lod_level)
         self.append_op(

@@ -33,6 +33,9 @@ def fc(input,
     Runs as a single MXU matmul per input."""
     helper = LayerHelper('fc', **locals())
     dtype = helper.input_dtype()
+    # fp32 master weights under bf16 activations: the op casts at use,
+    # the optimizer updates full-precision params (mixed-precision recipe)
+    p_dtype = 'float32' if dtype in ('bfloat16', 'float16') else dtype
     lod = max(v.lod_level for v in helper.multiple_input())
     mul_results = []
     flatten = num_flatten_dims
@@ -47,7 +50,8 @@ def fc(input,
             _prod(input_shape[flatten:])
         ] + [size]
         w = helper.create_parameter(
-            attr=param_attr, shape=param_shape, dtype=dtype, is_bias=False)
+            attr=param_attr, shape=param_shape, dtype=p_dtype,
+            is_bias=False)
         tmp = helper.create_tmp_variable(dtype, lod_level=input_var.lod_level)
         helper.append_op(
             type='mul',
@@ -129,8 +133,10 @@ def conv2d(input,
     groups = groups or 1
     filter_shape = [num_filters, num_channels // groups] + filter_size
     std = (2.0 / (filter_size[0] * filter_size[1] * num_channels)) ** 0.5
+    # fp32 master weights for low-precision activations (op casts at use)
+    p_dtype = 'float32' if dtype in ('bfloat16', 'float16') else dtype
     w = helper.create_parameter(
-        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        attr=helper.param_attr, shape=filter_shape, dtype=p_dtype,
         default_initializer=NormalInitializer(0.0, std))
     pre_bias = helper.create_tmp_variable(dtype)
     helper.append_op(

@@ -12,7 +12,7 @@ __all__ = ['resnet_cifar10', 'resnet_imagenet', 'build_imagenet']
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
-                  bias_attr=False):
+                  bias_attr=False, layout='NCHW'):
     tmp = fluid.layers.conv2d(
         input=input,
         filter_size=filter_size,
@@ -20,36 +20,40 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
         stride=stride,
         padding=padding,
         act=None,
-        bias_attr=bias_attr)
-    return fluid.layers.batch_norm(input=tmp, act=act)
+        bias_attr=bias_attr,
+        data_format=layout)
+    return fluid.layers.batch_norm(input=tmp, act=act, data_layout=layout)
 
 
-def shortcut(input, ch_in, ch_out, stride):
+def shortcut(input, ch_in, ch_out, stride, layout='NCHW'):
     if ch_in != ch_out or stride != 1:
-        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None,
+                             layout=layout)
     return input
 
 
-def basicblock(input, ch_in, ch_out, stride):
-    short = shortcut(input, ch_in, ch_out, stride)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+def basicblock(input, ch_in, ch_out, stride, layout='NCHW'):
+    short = shortcut(input, ch_in, ch_out, stride, layout)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, layout=layout)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, layout=layout)
     return fluid.layers.elementwise_add(x=short, y=conv2, act='relu')
 
 
-def bottleneck(input, ch_in, ch_out, stride):
-    short = shortcut(input, ch_in, ch_out * 4, stride)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+def bottleneck(input, ch_in, ch_out, stride, layout='NCHW'):
+    short = shortcut(input, ch_in, ch_out * 4, stride, layout)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, layout=layout)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, layout=layout)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          layout=layout)
     return fluid.layers.elementwise_add(x=short, y=conv3, act='relu')
 
 
-def layer_warp(block_func, input, ch_in, ch_out, count, stride):
-    res_out = block_func(input, ch_in, ch_out, stride)
+def layer_warp(block_func, input, ch_in, ch_out, count, stride,
+               layout='NCHW'):
+    res_out = block_func(input, ch_in, ch_out, stride, layout)
     ch_in = ch_out * (4 if block_func is bottleneck else 1)
     for _ in range(1, count):
-        res_out = block_func(res_out, ch_in, ch_out, 1)
+        res_out = block_func(res_out, ch_in, ch_out, 1, layout)
     return res_out
 
 
@@ -75,31 +79,45 @@ _DEPTH_CFG = {
 }
 
 
-def resnet_imagenet(input, depth=50, num_classes=1000):
+def resnet_imagenet(input, depth=50, num_classes=1000, layout='NCHW'):
     """Reference: benchmark/paddle/image/resnet.py (ImageNet layout)."""
     block, counts = _DEPTH_CFG[depth]
     conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
-                          padding=3)
+                          padding=3, layout=layout)
     pool1 = fluid.layers.pool2d(
         input=conv1, pool_size=3, pool_stride=2, pool_padding=1,
-        pool_type='max')
+        pool_type='max', data_format=layout)
     ch_in = 64
     out = pool1
     for i, (ch_out, count) in enumerate(zip([64, 128, 256, 512], counts)):
         stride = 1 if i == 0 else 2
-        out = layer_warp(block, out, ch_in, ch_out, count, stride)
+        out = layer_warp(block, out, ch_in, ch_out, count, stride, layout)
         ch_in = ch_out * (4 if block is bottleneck else 1)
     pool2 = fluid.layers.pool2d(
-        input=out, pool_size=7, pool_type='avg', global_pooling=True)
-    return fluid.layers.fc(input=pool2, size=num_classes, act='softmax')
+        input=out, pool_size=7, pool_type='avg', global_pooling=True,
+        data_format=layout)
+    # classifier head in fp32: softmax/cross-entropy stay well-conditioned
+    head = fluid.layers.cast(x=pool2, dtype='float32')
+    return fluid.layers.fc(input=head, size=num_classes, act='softmax')
 
 
-def build_imagenet(depth=50, num_classes=1000, image_shape=(3, 224, 224)):
-    """Returns (img, label, prediction, avg_cost, acc) — the bench model."""
+def build_imagenet(depth=50, num_classes=1000, image_shape=(3, 224, 224),
+                   dtype='float32', layout='NCHW'):
+    """Returns (img, label, prediction, avg_cost, acc) — the bench model.
+
+    dtype='bfloat16' runs conv/matmul activations in bf16 with fp32
+    accumulation (ops/conv.py preferred_element_type) and fp32 BN stats;
+    layout='NHWC' keeps channels minor — the MXU-preferred layout (feed
+    `image_shape` already permuted, e.g. (224, 224, 3)).
+    """
     img = fluid.layers.data(name='img', shape=list(image_shape),
                             dtype='float32')
     label = fluid.layers.data(name='label', shape=[1], dtype='int64')
-    prediction = resnet_imagenet(img, depth=depth, num_classes=num_classes)
+    x = img
+    if dtype == 'bfloat16':
+        x = fluid.layers.cast(x=x, dtype='bfloat16')
+    prediction = resnet_imagenet(x, depth=depth, num_classes=num_classes,
+                                 layout=layout)
     cost = fluid.layers.cross_entropy(input=prediction, label=label)
     avg_cost = fluid.layers.mean(x=cost)
     acc = fluid.layers.accuracy(input=prediction, label=label)

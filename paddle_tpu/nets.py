@@ -81,12 +81,41 @@ def glu(input, dim=-1):
 
 
 def scaled_dot_product_attention(queries, keys, values,
-                                 num_heads=1, dropout_rate=0.0):
+                                 num_heads=1, dropout_rate=0.0,
+                                 use_flash=False, causal=False):
     """Multi-head scaled dot-product attention (fluid/nets.py parity).
-    Inputs are [batch, seq, d]; runs as MXU batched matmuls."""
+    Inputs are [batch, seq, d]; runs as MXU batched matmuls.
+
+    use_flash=True routes through the fused Pallas online-softmax kernel
+    (ops/pallas/flash_attention.py) — no [Tq, Tk] score matrix in HBM;
+    dropout_rate must be 0 on that path."""
     if num_heads < 1:
         raise ValueError("num_heads must be >= 1")
     head_dim = queries.shape[-1] // num_heads
+
+    if use_flash:
+        if dropout_rate:
+            raise ValueError("flash attention path has no attention-"
+                             "probability dropout")
+        from .layers.layer_helper import LayerHelper
+        helper = LayerHelper('flash_attention')
+
+        def _bthd(x):
+            return layers.reshape(
+                x=x, shape=[x.shape[0] if x.shape[0] > 0 else -1,
+                            x.shape[1], num_heads, head_dim])
+
+        q4, k4, v4 = _bthd(queries), _bthd(keys), _bthd(values)
+        ctx_out = helper.create_tmp_variable(queries.dtype)
+        helper.append_op(
+            type='flash_attention',
+            inputs={'Q': [q4], 'K': [k4], 'V': [v4]},
+            outputs={'Out': [ctx_out]},
+            attrs={'causal': bool(causal)})
+        return layers.reshape(
+            x=ctx_out, shape=[queries.shape[0] if queries.shape[0] > 0
+                              else -1, queries.shape[1],
+                              num_heads * head_dim])
 
     def _split_heads(x):
         if num_heads == 1:

@@ -1,0 +1,26 @@
+"""Program-level fused attention op riding the Pallas kernel.
+
+Reference parity: the reference composes attention from matmul+softmax ops
+(fluid nets.py scaled_dot_product_attention); this op is the TPU-native
+fused form — ops/pallas/flash_attention.py online-softmax kernel, O(block)
+on-chip memory instead of a [Tq, Tk] HBM score matrix.
+"""
+from ..core.registry import register_op
+from .common import first, out
+
+
+@register_op('flash_attention')
+def _flash_attention(ctx, ins, attrs):
+    # lazy: jax.experimental.pallas loads only when the op actually runs,
+    # keeping `import paddle_tpu` free of the pallas extras
+    from .pallas import flash_attention
+    q = first(ins, 'Q')  # [B, T, H, D] or [B, T, D]
+    k = first(ins, 'K')
+    v = first(ins, 'V')
+    y = flash_attention(
+        q, k, v,
+        causal=attrs.get('causal', False),
+        scale=attrs.get('scale', None),
+        block_q=attrs.get('block_q', 128),
+        block_k=attrs.get('block_k', 128))
+    return out(y.astype(q.dtype))

@@ -15,6 +15,14 @@ from .common import first, out
 _ACC = dict(preferred_element_type=jnp.float32)
 
 
+def _acc(x):
+    """fp32 accumulation hint.  Omitted for bf16 operands: jax's conv
+    TRANSPOSE rule rejects preferred_element_type != operand dtype, and on
+    TPU the MXU accumulates bf16 dots in fp32 internally anyway (rounding
+    once at the output tile)."""
+    return _ACC if x.dtype == jnp.float32 else {}
+
+
 def _pair(v, n=2):
     if isinstance(v, (list, tuple)):
         return list(v)
@@ -38,7 +46,7 @@ def _conv2d(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=dn,
         feature_group_count=groups,
-        **_ACC)
+        **_acc(x))
     return {'Output': [y.astype(x.dtype)]}
 
 
@@ -57,7 +65,7 @@ def _conv3d(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
         feature_group_count=groups,
-        **_ACC)
+        **_acc(x))
     return {'Output': [y.astype(x.dtype)]}
 
 
@@ -79,7 +87,7 @@ def _conv_transpose(x, w, strides, paddings, dilations, spatial):
         lhs_dilation=strides,
         rhs_dilation=dilations,
         dimension_numbers=dn,
-        **_ACC)
+        **_acc(x))
     return y.astype(x.dtype)
 
 

@@ -15,6 +15,9 @@ _ACC = dict(preferred_element_type=jnp.float32)
 
 
 def _matmul_acc(a, b):
+    # fp32 master weights meet bf16 activations here: compute in the
+    # activation dtype (MXU bf16 path, internal fp32 accumulation)
+    b = b.astype(a.dtype)
     y = jnp.matmul(a, b, **_ACC)
     return y.astype(a.dtype)
 
@@ -59,6 +62,11 @@ def _elementwise(name, fn):
     def _impl(ctx, ins, attrs, _fn=fn):
         x = first(ins, 'X')
         y = bcast_axis(x, first(ins, 'Y'), attrs.get('axis', -1))
+        if y.dtype != x.dtype and jnp.issubdtype(x.dtype, jnp.floating) \
+                and jnp.issubdtype(y.dtype, jnp.floating):
+            # fp32 master params meeting low-precision activations: stay
+            # in the activation dtype instead of silently promoting
+            y = y.astype(x.dtype)
         return out(_fn(x, y))
 
     return _impl

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels (SURVEY §6.6): hand-fused hot ops XLA won't fuse.
+
+Tests run them with interpret=True on CPU; on a TPU backend the same
+kernels compile to Mosaic.
+"""
+from .flash_attention import flash_attention  # noqa: F401
+
+__all__ = ['flash_attention']

@@ -1,0 +1,238 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Reference parity: the reference's attention rides separate matmul/softmax
+ops (scaled_dot_product_attention in fluid nets.py) materializing the
+[Tq, Tk] score matrix in HBM.  This kernel keeps the online-softmax
+running (max, sum, acc) state in VMEM across K blocks — O(block) memory,
+one HBM pass — the bandwidth-bound fusion XLA does not do by itself.
+
+Forward is the Pallas kernel (grid = (batch*heads, q blocks, k blocks),
+VMEM scratch carries m/l/acc between k iterations).  Backward is the
+standard flash recompute in plain jax (lax.scan over K blocks with the
+saved logsumexp) — O(T·block) memory, no score matrix.
+
+On non-TPU backends the kernel runs with interpret=True, so the same
+code path is exercised by CPU CI.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ['flash_attention']
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, scale, causal, block_q, block_k, nk, tk):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)  # [bk, d]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = kpos < tk  # last block may be padding past the real length
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = valid & (qpos >= kpos)
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_scr[:, 0]  # [bq]
+    l_prev = l_scr[:, 0]
+    m_cur = jnp.max(s, axis=1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    # explicit zero for masked entries: when a whole row is masked,
+    # s == m_new == _NEG_INF and bare exp(s - m_new) would be 1
+    p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1)
+    acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse broadcast across the 128-lane axis (Mosaic wants the last
+        # two block dims (block_q, 128); column 0 is read back outside)
+        lse = m_scr[:, 0] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None],
+                                      lse_ref.shape[1:]).astype(
+                                          lse_ref.dtype)
+
+
+def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T])."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    nq = pl.cdiv(tq, block_q)
+    nk = pl.cdiv(tk, block_k)
+    # pad sequence dims to block multiples: Mosaic requires block shapes
+    # that divide (or equal) the array dims; padded K columns are masked
+    # in-kernel via `tk`, padded Q rows are sliced off below
+    tq_p, tk_p = nq * block_q, nk * block_k
+    if tq_p != tq:
+        q = jnp.pad(q, ((0, 0), (0, tq_p - tq), (0, 0)))
+    if tk_p != tk:
+        k = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0)))
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, nk=nk,
+                               tk=tk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq_p, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
+                       interpret):
+    tq = q.shape[1]
+    o, lse = _fa_forward(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return o[:, :tq], lse[:, :tq, 0]
+
+
+def _dense_ref(q, k, v, causal, scale):
+    s = jnp.einsum('btd,bsd->bts', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = s.shape[1], s.shape[2]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bts,bsd->btd', p, v.astype(jnp.float32))
+
+
+def _fa_backward(causal, scale, block_k, res, do):
+    """Flash backward: recompute scores per K block against the saved
+    logsumexp; never materializes [Tq, Tk]."""
+    q, k, v, o, lse = res
+    qf = q.astype(jnp.float32)
+    do = do.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    di = jnp.sum(do * of, axis=-1)  # [BH, T]
+    tk = k.shape[1]
+    bk = min(block_k, tk)
+    nk = pl.cdiv(tk, bk)
+    pad = nk * bk - tk
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    kpos0 = jnp.arange(nk) * bk
+    tq = q.shape[1]
+    qpos = jnp.arange(tq)
+
+    def kblock(carry, inp):
+        dq_acc = carry
+        kb, vb, k0 = inp  # [BH, bk, D], [BH, bk, D], scalar
+        kf = kb.astype(jnp.float32)
+        vf = vb.astype(jnp.float32)
+        s = jnp.einsum('btd,bsd->bts', qf, kf) * scale
+        kpos = k0 + jnp.arange(bk)
+        valid = (kpos < tk)[None, None, :]
+        if causal:
+            valid = valid & (qpos[:, None] >= kpos[None, :])[None]
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])  # [BH, Tq, bk]
+        p = jnp.where(valid, p, 0.0)
+        dv = jnp.einsum('bts,btd->bsd', p, do)
+        dp = jnp.einsum('btd,bsd->bts', do, vf)
+        ds = p * (dp - di[:, :, None]) * scale
+        dq_acc = dq_acc + jnp.einsum('bts,bsd->btd', ds, kf)
+        dk = jnp.einsum('bts,btd->bsd', ds, qf)
+        return dq_acc, (dk, dv)
+
+    kb = kp.reshape(kp.shape[0], nk, bk, -1).swapaxes(0, 1)
+    vb = vp.reshape(vp.shape[0], nk, bk, -1).swapaxes(0, 1)
+    dq, (dks, dvs) = jax.lax.scan(
+        kblock, jnp.zeros_like(qf), (kb, vb, kpos0))
+    dk = dks.swapaxes(0, 1).reshape(kp.shape)[:, :tk]
+    dv = dvs.swapaxes(0, 1).reshape(vp.shape)[:, :tk]
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    interpret = jax.default_backend() != 'tpu'
+    o, _ = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    interpret = jax.default_backend() != 'tpu'
+    o, lse = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
+                                interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    return _fa_backward(causal, scale, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128):
+    """Fused attention over [B, T, H, D] (or [BH, T, D]) tensors.
+
+    Returns softmax(q k^T * scale [+ causal mask]) v with O(block) live
+    memory on-chip.  Differentiable (flash recompute backward).
+    """
+    squeeze = False
+    if q.ndim == 3:
+        q4, k4, v4 = (x[:, :, None, :] for x in (q, k, v))
+        squeeze = True
+    else:
+        q4, k4, v4 = q, k, v
+    b, tq, h, d = q4.shape
+    tk = k4.shape[1]
+    if scale is None:
+        scale = float(d) ** -0.5
+    qf = q4.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k4.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v4.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    o = _flash(qf, kf, vf, bool(causal), float(scale), int(block_q),
+               int(block_k))
+    o = o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return o[:, :, 0, :] if squeeze else o

@@ -155,9 +155,14 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     over samples with a bounded queue of ``buffer_size``.
 
     Reference parity: decorator.py xmap_readers (threads there too).  When
-    the native runtime is built, the same contract is served by the C++
-    thread pool (runtime/native.py: NativeXmap) — this is the fallback.
+    the native runtime builds (runtime/native.py), the handoff queues live
+    in C++ and their blocking ops release the GIL (N1); this python-queue
+    body is the fallback.
     """
+    from ..runtime import native as _native
+    if _native.available():
+        from ..runtime.prefetch import xmap_native
+        return xmap_native(mapper, reader, process_num, buffer_size, order)
     end = XmapEndSignal()
 
     def read_worker(r, in_q):

@@ -1,0 +1,11 @@
+"""C11/N1-N3 — native runtime: C++ prefetch queue, recordio, staging
+arena with pure-Python fallbacks.
+"""
+from .native import (available, NativeQueue, NativeRecordReader,
+                     NativeRecordWriter, StagingArena)
+from .prefetch import prefetch_reader, xmap_native
+from .feed import FeedPipeline
+
+__all__ = ['available', 'NativeQueue', 'NativeRecordReader',
+           'NativeRecordWriter', 'StagingArena', 'prefetch_reader',
+           'xmap_native', 'FeedPipeline']

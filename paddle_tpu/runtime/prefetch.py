@@ -1,0 +1,111 @@
+"""N1 — prefetch pipeline over the native queue.
+
+Reference parity: python/paddle/v2/reader/decorator.py:318 xmap_readers
+(thread pool + queues) and the C++ threadpool the reference's data layer
+rides.  Producers serialize samples (pickle) into the C++ ring buffer;
+blocking queue ops run without the GIL, so decode/augment work overlaps
+the train step — this is what feeds the MXU at rate.
+"""
+import pickle
+import threading
+
+from .native import NativeQueue
+
+__all__ = ['prefetch_reader', 'xmap_native']
+
+_END = b'\x00__PTQ_END__'
+
+
+def prefetch_reader(reader, buf_size=64):
+    """Wrap a sample reader so a background thread stays `buf_size`
+    batches ahead of the consumer."""
+
+    def _reader():
+        q = NativeQueue(buf_size)
+
+        def produce():
+            try:
+                for sample in reader():
+                    if not q.push(pickle.dumps(
+                            sample, protocol=pickle.HIGHEST_PROTOCOL)):
+                        return  # consumer closed early
+            finally:
+                q.push(_END)
+                q.close()
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                blob = q.pop()
+                if blob is None or blob == _END:
+                    break
+                yield pickle.loads(blob)
+        finally:
+            q.close()
+            t.join(timeout=5)
+
+    return _reader
+
+
+def xmap_native(mapper, reader, process_num=4, buffer_size=64,
+                order=False):
+    """Parallel map over a reader through native queues (xmap_readers
+    parity; thread workers — same as the reference's python version — but
+    handoff buffers live in C++ and their blocking ops drop the GIL)."""
+
+    def _reader():
+        in_q = NativeQueue(buffer_size)
+        out_q = NativeQueue(buffer_size)
+        n_done = [0]
+        done_lock = threading.Lock()
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.push(pickle.dumps((i, sample)))
+            finally:
+                for _ in range(process_num):
+                    in_q.push(_END)
+
+        def work():
+            while True:
+                blob = in_q.pop()
+                if blob is None or blob == _END:
+                    break
+                i, sample = pickle.loads(blob)
+                out_q.push(pickle.dumps((i, mapper(sample))))
+            with done_lock:
+                n_done[0] += 1
+                if n_done[0] == process_num:
+                    out_q.push(_END)
+
+        threads = [threading.Thread(target=feed, daemon=True)]
+        threads += [threading.Thread(target=work, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        pending = {}
+        next_idx = 0
+        try:
+            while True:
+                blob = out_q.pop()
+                if blob is None or blob == _END:
+                    break
+                i, mapped = pickle.loads(blob)
+                if not order:
+                    yield mapped
+                    continue
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+            if order:  # drain any stragglers in order
+                for i in sorted(pending):
+                    yield pending[i]
+        finally:
+            in_q.close()
+            out_q.close()
+
+    return _reader

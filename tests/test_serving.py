@@ -1,0 +1,36 @@
+"""N4 — saved-HLO export/serving round trip.
+
+Reference parity: paddle/capi load-and-predict surface, realized as
+jax.export StableHLO artifacts.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import export_inference, InferenceServer
+
+
+def test_export_and_serve_roundtrip(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 9
+    startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        pred = fluid.layers.fc(input=h, size=3, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    feed = {'x': np.random.RandomState(0).randn(4, 6).astype('float32')}
+    want, = exe.run(main, feed=feed, fetch_list=[pred])
+
+    path = str(tmp_path / 'model.stablehlo')
+    size = export_inference(path, {'x': (4, 6)}, [pred], executor=exe,
+                            main_program=main)
+    assert size > 0
+
+    server = InferenceServer(path)
+    got, = server.predict(feed)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(got.sum(axis=1), np.ones(4), rtol=1e-5)
