@@ -1,0 +1,94 @@
+"""O18 — program-level collective ops.
+
+Reference parity: paddle/operators/nccl_op.cc (ncclAllReduce/Bcast/
+Reduce as graph ops) and the pserver send/recv pair.  TPU-native design:
+the op bodies call the named-axis collectives from parallel/collective.py,
+so a Program containing them executes under `shard_map` over a Mesh axis
+(collectives ride ICI); interpreted on a single device with no axis bound,
+each op degrades to its one-participant semantics (identity), matching
+nccl with a world size of 1.
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first, out
+from ..parallel import collective
+
+
+def _axis_bound(axis_name):
+    """True when `axis_name` is a mapped axis of the current trace
+    (i.e. the op is being traced inside shard_map over that axis)."""
+    import jax.core as jc
+    try:
+        return axis_name in jc.unsafe_get_axis_names_DO_NOT_USE()
+    except Exception:
+        try:  # fallback: an unbound axis raises NameError at trace time
+            collective.axis_size(axis_name)
+            return True
+        except NameError:
+            return False
+
+
+@register_op('allreduce')
+def _allreduce(ctx, ins, attrs):
+    x = first(ins, 'X')
+    axis = attrs.get('axis', attrs.get('ring_id', 'dp'))
+    op = attrs.get('reduction', attrs.get('op', 'sum'))
+    if not _axis_bound(axis):
+        return out(x)  # world size 1
+    return out(collective.allreduce(x, axis, op=op))
+
+
+@register_op('broadcast')
+def _broadcast(ctx, ins, attrs):
+    x = first(ins, 'X')
+    axis = attrs.get('axis', 'dp')
+    root = attrs.get('root', 0)
+    if not _axis_bound(axis):
+        return out(x)
+    return out(collective.broadcast(x, axis, root=root))
+
+
+@register_op('allgather')
+def _allgather(ctx, ins, attrs):
+    x = first(ins, 'X')
+    axis = attrs.get('axis', 'dp')
+    if not _axis_bound(axis):
+        return out(x)
+    return out(collective.allgather(x, axis,
+                                    axis=attrs.get('concat_axis', 0)))
+
+
+@register_op('reducescatter')
+def _reducescatter(ctx, ins, attrs):
+    x = first(ins, 'X')
+    axis = attrs.get('axis', 'dp')
+    if not _axis_bound(axis):
+        return out(x)
+    return out(collective.reduce_scatter(
+        x, axis, axis=attrs.get('scatter_axis', 0)))
+
+
+@register_op('send')
+def _send(ctx, ins, attrs):
+    """pserver send ≡ the grad side of an fsdp reduce_scatter; as a
+    single op it reduces over the axis (params flow back via 'recv')."""
+    x = first(ins, 'X')
+    axis = attrs.get('axis', 'fsdp')
+    if not _axis_bound(axis):
+        return out(x)
+    return out(collective.allreduce(x, axis, op='sum'))
+
+
+@register_op('recv')
+def _recv(ctx, ins, attrs):
+    """pserver recv ≡ broadcast of the updated value from the owner."""
+    x = first(ins, 'X')
+    axis = attrs.get('axis', 'fsdp')
+    if not _axis_bound(axis):
+        return out(x)
+    return out(collective.broadcast(x, axis, root=attrs.get('root', 0)))
+
+
+def _noop_import():  # keep jnp import referenced for future ops
+    return jnp

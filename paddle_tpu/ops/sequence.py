@@ -230,3 +230,20 @@ def _pool_shim(ctx, ins, ptype):
     from ..core.registry import get_op_impl
     return get_op_impl('sequence_pool').compute(ctx, ins,
                                                 {'pooltype': ptype})
+
+
+@register_op('reorder_lod_tensor_by_rank')
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """Reorder batch rows by descending rank-table length (operators/
+    reorder_lod_tensor_by_rank_op.cc).  The reference sorts sequences so
+    RNNs can shrink their batch; on padded batches the op is a stable
+    argsort by length — masks make it a no-op numerically, but the order
+    (and its inverse, for restoration) is exposed for parity."""
+    x = first(ins, 'X')
+    table = first(ins, 'RankTable').astype(jnp.int32).reshape(-1)
+    # stable sort by descending length
+    order = jnp.argsort(-table, stable=True)
+    y = jnp.take(x, order, axis=0)
+    new_len = table[order]
+    return {'Out': [y], 'OutLen': [new_len],
+            'OrderedIndex': [order.astype(jnp.int32)]}

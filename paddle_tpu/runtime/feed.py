@@ -53,6 +53,7 @@ class FeedPipeline(object):
             self._free.push(bytes([i]))
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._started = False
+        self._error = None
 
     def _views(self, idx):
         mv, _tok = self._blocks[idx]
@@ -74,9 +75,12 @@ class FeedPipeline(object):
             views = self._views(idx)
             try:
                 ok = self._fill(views, step)
-            except Exception:
+            except BaseException as e:
+                # surface the pipeline failure to the consumer instead of
+                # masquerading as a clean end-of-stream
+                self._error = e
                 self._ready.close()
-                raise
+                return
             if ok is False:
                 self._ready.close()
                 return
@@ -97,6 +101,9 @@ class FeedPipeline(object):
         while True:
             tok = self._ready.pop()
             if tok is None:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "feed pipeline producer failed") from self._error
                 return
             idx = tok[0]
             views = self._views(idx)

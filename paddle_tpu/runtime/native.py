@@ -184,6 +184,8 @@ class NativeRecordWriter(object):
     def write(self, payload):
         if self._w is not None:
             return self._w.write(payload)
+        if not self._h:
+            raise ValueError("write to a closed record writer")
         if self._lib.rio_writer_write(self._h, bytes(payload),
                                       len(payload)) != 0:
             raise IOError("record write failed")
@@ -223,6 +225,8 @@ class NativeRecordReader(object):
     def __next__(self):
         if self._r is not None:
             return next(self._r)
+        if not self._h:  # exhausted/closed: keep raising, never segfault
+            raise StopIteration
         out = ctypes.POINTER(ctypes.c_char)()
         n = self._lib.rio_reader_next(self._h, ctypes.byref(out))
         if n == -1:

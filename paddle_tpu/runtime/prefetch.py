@@ -58,6 +58,7 @@ def xmap_native(mapper, reader, process_num=4, buffer_size=64,
         in_q = NativeQueue(buffer_size)
         out_q = NativeQueue(buffer_size)
         n_done = [0]
+        errors = []
         done_lock = threading.Lock()
 
         def feed():
@@ -69,16 +70,22 @@ def xmap_native(mapper, reader, process_num=4, buffer_size=64,
                     in_q.push(_END)
 
         def work():
-            while True:
-                blob = in_q.pop()
-                if blob is None or blob == _END:
-                    break
-                i, sample = pickle.loads(blob)
-                out_q.push(pickle.dumps((i, mapper(sample))))
-            with done_lock:
-                n_done[0] += 1
-                if n_done[0] == process_num:
-                    out_q.push(_END)
+            try:
+                while True:
+                    blob = in_q.pop()
+                    if blob is None or blob == _END:
+                        break
+                    i, sample = pickle.loads(blob)
+                    out_q.push(pickle.dumps((i, mapper(sample))))
+            except BaseException as e:  # surface to the consumer
+                errors.append(e)
+            finally:
+                # always count down so the consumer never hangs on a
+                # crashed worker; the stored error re-raises at the end
+                with done_lock:
+                    n_done[0] += 1
+                    if n_done[0] == process_num:
+                        out_q.push(_END)
 
         threads = [threading.Thread(target=feed, daemon=True)]
         threads += [threading.Thread(target=work, daemon=True)
@@ -104,6 +111,8 @@ def xmap_native(mapper, reader, process_num=4, buffer_size=64,
             if order:  # drain any stragglers in order
                 for i in sorted(pending):
                     yield pending[i]
+            if errors:
+                raise errors[0]
         finally:
             in_q.close()
             out_q.close()

@@ -1,0 +1,67 @@
+"""O18 — program-level collective ops under shard_map and single-device.
+
+Reference parity: paddle/operators/nccl_op tests (allreduce/bcast as
+graph ops) + pserver send/recv semantics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from op_test import run_op
+from paddle_tpu.parallel import api, collective
+
+
+def need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d devices" % n)
+
+
+def test_single_device_identity():
+    """With no mapped axis each collective is its world-size-1 form."""
+    x = np.arange(6, dtype='float32').reshape(2, 3)
+    for op in ['allreduce', 'broadcast', 'allgather', 'reducescatter',
+               'send', 'recv']:
+        got = np.asarray(run_op(op, {'X': x}, {'axis': 'dp'})['Out'][0])
+        np.testing.assert_allclose(got, x, err_msg=op)
+
+
+def test_collective_ops_under_shard_map():
+    need_devices(8)
+    from paddle_tpu.core.registry import get_op_impl
+
+    mesh = api.make_mesh((8,), ('dp',))
+    x = np.arange(8, dtype='float32').reshape(8, 1)
+
+    class _Ctx(object):
+        rng = None
+
+    def f(xs):
+        ar = get_op_impl('allreduce').compute(
+            _Ctx(), {'X': [xs]}, {'axis': 'dp'})['Out'][0]
+        bc = get_op_impl('broadcast').compute(
+            _Ctx(), {'X': [xs]}, {'axis': 'dp', 'root': 2})['Out'][0]
+        ag = get_op_impl('allgather').compute(
+            _Ctx(), {'X': [xs]}, {'axis': 'dp'})['Out'][0]
+        return ar, bc, ag
+
+    ar, bc, ag = collective.shard_map(
+        f, mesh=mesh, in_specs=P('dp', None),
+        out_specs=(P('dp', None), P('dp', None), P('dp', None)))(x)
+    assert np.allclose(np.asarray(ar), 28.0)
+    assert np.allclose(np.asarray(bc), 2.0)
+    assert np.asarray(ag).shape == (64, 1)  # 8 shards x full gather
+
+
+def test_reorder_lod_tensor_by_rank():
+    x = np.arange(12, dtype='float32').reshape(4, 3)
+    table = np.array([2, 5, 1, 5], dtype='int64')
+    outs = run_op('reorder_lod_tensor_by_rank',
+                  {'X': x, 'RankTable': table})
+    order = np.asarray(outs['OrderedIndex'][0])
+    # stable descending by length: rows 1, 3 (len 5), 0 (2), 2 (1)
+    np.testing.assert_array_equal(order, [1, 3, 0, 2])
+    np.testing.assert_allclose(np.asarray(outs['Out'][0]), x[order])
+    np.testing.assert_array_equal(np.asarray(outs['OutLen'][0]),
+                                  [5, 5, 2, 1])

@@ -1,0 +1,48 @@
+"""D7 — multi-host launch bring-up logic (single-host path + env
+protocol parsing; real multi-host needs actual hosts).
+
+Reference parity: benchmark/cluster PADDLE_INIT_* env protocol.
+"""
+import jax
+import pytest
+
+from paddle_tpu.distributed import launch
+from paddle_tpu.parallel import api
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    launch.shutdown()
+    yield
+    launch.shutdown()
+
+
+def test_single_host_initialize_is_noop(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_COORDINATOR', raising=False)
+    launch.initialize()
+    assert launch.is_initialized()
+    # still one process; jax.distributed untouched
+    assert len(jax.devices()) >= 1
+
+
+def test_reference_env_names_accepted(monkeypatch):
+    # world size 1 short-circuits before jax.distributed comes up
+    monkeypatch.setenv('PADDLE_INIT_PSERVERS', '127.0.0.1:7164')
+    monkeypatch.setenv('PADDLE_INIT_TRAINER_COUNT', '1')
+    monkeypatch.setenv('PADDLE_INIT_TRAINER_ID', '0')
+    launch.initialize()
+    assert launch.is_initialized()
+
+
+def test_global_mesh_builds_over_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    launch.initialize()
+    mesh = launch.global_mesh((2, 4), ('dp', 'tp'))
+    assert mesh.shape == {'dp': 2, 'tp': 4}
+
+
+def test_initialize_idempotent():
+    launch.initialize()
+    launch.initialize()  # second call is a no-op
+    assert launch.is_initialized()

@@ -197,6 +197,51 @@ def test_feed_pipeline_streams_device_batches():
                                       np.full((4, 1), 2 * i, np.int32))
 
 
+def test_xmap_native_mapper_error_propagates_no_hang():
+    def source():
+        for i in range(20):
+            yield i
+
+    def bad_mapper(x):
+        if x == 7:
+            raise ValueError("corrupt sample")
+        return x
+
+    with pytest.raises(ValueError, match='corrupt sample'):
+        list(xmap_native(bad_mapper, source, process_num=3,
+                         buffer_size=4)())
+
+
+def test_record_reader_exhaustion_keeps_raising(tmp_path):
+    path = str(tmp_path / 'r.rio')
+    with NativeRecordWriter(path) as w:
+        w.write(b'one')
+    r = NativeRecordReader(path)
+    assert list(r) == [b'one']
+    with pytest.raises(StopIteration):
+        next(r)  # must raise again, not crash on the closed handle
+    with pytest.raises(StopIteration):
+        next(r)
+    w2 = NativeRecordWriter(str(tmp_path / 'w.rio'))
+    w2.close()
+    if available():
+        with pytest.raises(ValueError, match='closed'):
+            w2.write(b'late')
+
+
+def test_feed_pipeline_fill_error_raises():
+    from paddle_tpu.runtime import FeedPipeline
+
+    def fill(views, step):
+        if step == 2:
+            raise IOError("shard unreadable")
+        views['x'][:] = step
+
+    pipe = FeedPipeline({'x': ((2,), np.float32)}, fill, depth=2)
+    with pytest.raises(RuntimeError, match='producer failed'):
+        list(pipe)
+
+
 def test_xmap_readers_uses_native_backend():
     from paddle_tpu.reader.decorator import xmap_readers
 
