@@ -1,0 +1,44 @@
+"""BASELINE config 5: CTR DeepFM with high-dim sparse tables —
+examples/s (SelectedRows grads keep the vocab-height dense grad off the
+chip)."""
+import numpy as np
+
+from common import run_bench, on_tpu
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    batch = 4096 if on_tpu() else 64
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            feeds, predict, avg_cost, auc = models.ctr.build('deepfm')
+            fluid.optimizer.AdagradOptimizer(0.01).minimize(avg_cost)
+        assert any(op.type == 'sparse_grad_assemble'
+                   for op in main_p.global_block().ops)
+        return main_p, startup, avg_cost
+
+    from paddle_tpu.models.ctr import (DENSE_DIM, NUM_SLOTS,
+                                       SPARSE_FEATURE_DIM)
+    rng = np.random.default_rng(0)
+
+    def feed():
+        ln = np.full((batch,), 1, np.int32)
+        out = {'dense': rng.normal(size=(batch, DENSE_DIM)).astype(
+            np.float32),
+            'label': rng.integers(0, 2, (batch, 1)).astype(np.int32)}
+        for i in range(NUM_SLOTS):
+            out['sparse_%d' % i] = (rng.integers(
+                0, SPARSE_FEATURE_DIM, (batch, 1, 1)).astype(np.int32), ln)
+        return out
+
+    run_bench('ctr_deepfm_examples_per_sec', batch, build, feed,
+              note='batch=%d slots=%d dim=%d' % (batch, NUM_SLOTS,
+                                                 SPARSE_FEATURE_DIM))
+
+
+if __name__ == '__main__':
+    main()

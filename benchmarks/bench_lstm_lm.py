@@ -1,0 +1,39 @@
+"""BASELINE config 3: stacked-LSTM language model — tokens/s
+(benchmark/paddle/rnn counterpart; variable-length sequences ride the
+padded+lengths representation)."""
+import numpy as np
+
+from common import run_bench, on_tpu
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import rnn_lm
+
+    if on_tpu():
+        batch, seq, vocab = 128, 128, 10000
+    else:
+        batch, seq, vocab = 8, 16, 200
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            src, target, avg_cost = rnn_lm.build(vocab_size=vocab)
+            fluid.optimizer.AdagradOptimizer(0.1).minimize(avg_cost)
+        return main_p, startup, avg_cost
+
+    rng = np.random.default_rng(0)
+
+    def feed():
+        ln = np.full((batch,), seq, np.int32)
+        mk = lambda: rng.integers(1, vocab, (batch, seq, 1)).astype(
+            np.int32)
+        return {'src': (mk(), ln), 'target': (mk(), ln)}
+
+    run_bench('stacked_lstm_tokens_per_sec', batch * seq, build, feed,
+              steps=10 if on_tpu() else 3,
+              note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,32 @@
+"""BASELINE config 1: Fluid MNIST convnet — examples/s."""
+import numpy as np
+
+from common import run_bench, on_tpu
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import mnist
+
+    batch = 512 if on_tpu() else 64
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            img, label, pred, avg_cost, acc = mnist.build('conv')
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
+        return main_p, startup, avg_cost
+
+    rng = np.random.default_rng(0)
+
+    def feed():
+        return {'img': rng.normal(size=(batch, 1, 28, 28)).astype(
+                    np.float32),
+                'label': rng.integers(0, 10, (batch, 1)).astype(np.int32)}
+
+    run_bench('mnist_conv_examples_per_sec', batch, build, feed,
+              note='batch=%d' % batch)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,41 @@
+"""BASELINE config 4: seq2seq + attention NMT — target tokens/s
+(book/machine_translation counterpart)."""
+import numpy as np
+
+from common import run_bench, on_tpu
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import seq2seq
+
+    if on_tpu():
+        batch, seq, vocab, dim = 64, 64, 30000, 512
+    else:
+        batch, seq, vocab, dim = 4, 8, 100, 32
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            src, trg, label, pred, avg_cost = seq2seq.build(
+                dict_size=vocab, word_dim=dim // 2, hidden_dim=dim)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
+        return main_p, startup, avg_cost
+
+    rng = np.random.default_rng(0)
+
+    def feed():
+        ln = np.full((batch,), seq, np.int32)
+        mk = lambda: (rng.integers(1, vocab, (batch, seq, 1)).astype(
+            np.int32), ln)
+        return {'src_word_id': mk(), 'target_language_word': mk(),
+                'target_language_next_word': mk()}
+
+    run_bench('seq2seq_attention_tokens_per_sec', batch * seq, build,
+              feed, steps=10 if on_tpu() else 3,
+              note='batch=%d seq=%d vocab=%d dim=%d' % (batch, seq,
+                                                        vocab, dim))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,44 @@
+"""BASELINE config 2b: VGG-16 ImageNet — img/s (benchmark/paddle/image/
+vgg.py counterpart)."""
+import numpy as np
+
+from common import run_bench, on_tpu
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import vgg
+
+    if on_tpu():
+        batch, hw, classes = 32, 224, 1000
+    else:
+        batch, hw, classes = 4, 32, 10
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            img = fluid.layers.data(name='img', shape=[3, hw, hw],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            pred = vgg.vgg_imagenet(img, num_classes=classes)
+            cost = fluid.layers.mean(
+                x=fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.MomentumOptimizer(0.01, 0.9).minimize(cost)
+        return main_p, startup, cost
+
+    rng = np.random.default_rng(0)
+
+    def feed():
+        return {'img': rng.normal(size=(batch, 3, hw, hw)).astype(
+                    np.float32),
+                'label': rng.integers(0, classes, (batch, 1)).astype(
+                    np.int32)}
+
+    run_bench('vgg16_train_img_per_sec', batch, build, feed,
+              steps=10 if on_tpu() else 3,
+              note='batch=%d hw=%d' % (batch, hw))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,55 @@
+"""Shared timing harness for the secondary benchmarks (SURVEY §5 /
+BASELINE.json configs).  Each script builds a train program, feeds a
+device-staged synthetic batch, and prints ONE JSON line like bench.py.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def on_tpu():
+    import jax
+    return any(d.platform == 'tpu' for d in jax.devices())
+
+
+def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
+              note=None):
+    """build() -> (program, startup, loss_var); feed_fn() -> feed dict.
+    unit_count = units (imgs/tokens/examples) per step."""
+    import jax
+    import paddle_tpu as fluid
+
+    program, startup, loss = build()
+    place = fluid.TPUPlace(0) if on_tpu() else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    dev = place.jax_device()
+    feed = {k: jax.device_put(v, dev) for k, v in feed_fn().items()}
+
+    for _ in range(warmup):
+        out = exe.run(program, feed=feed, fetch_list=[loss])
+    np.asarray(out[0])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(program, feed=feed, fetch_list=[loss],
+                      return_numpy=False)
+    val = float(np.asarray(out[0]).ravel()[0])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(val), "loss went non-finite"
+
+    result = {
+        "metric": metric,
+        "value": round(unit_count * steps / dt, 2),
+    }
+    if note:
+        result["note"] = note
+    print(json.dumps(result))
+    return result

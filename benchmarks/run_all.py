@@ -1,0 +1,21 @@
+"""Run every secondary benchmark (SURVEY §5 / BASELINE configs 1-5) and
+print one JSON line each.  The headline ResNet-50 bench lives in
+../bench.py."""
+import subprocess
+import sys
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BENCHES = ['bench_mnist.py', 'bench_vgg.py', 'bench_lstm_lm.py',
+           'bench_seq2seq.py', 'bench_ctr.py']
+
+if __name__ == '__main__':
+    failed = []
+    for b in BENCHES:
+        r = subprocess.run([sys.executable, os.path.join(HERE, b)],
+                           cwd=HERE)
+        if r.returncode != 0:
+            failed.append(b)
+    if failed:
+        print('FAILED: %s' % ', '.join(failed), file=sys.stderr)
+        sys.exit(1)

@@ -79,6 +79,19 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                                           lse_ref.dtype)
 
 
+def _sds(shape, dtype):
+    """ShapeDtypeStruct annotated as varying over the ambient mapped axes
+    so a pallas_call inside shard_map passes strict vma checking."""
+    try:
+        import jax.core as jc
+        vma = frozenset(jc.unsafe_get_axis_names_DO_NOT_USE())
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except Exception:
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T])."""
     bh, tq, d = q.shape
@@ -112,8 +125,8 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq_p, 128), jnp.float32),
+            _sds((bh, tq_p, d), q.dtype),
+            _sds((bh, tq_p, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -143,14 +156,17 @@ def _dense_ref(q, k, v, causal, scale):
     return jnp.einsum('bts,bsd->btd', p, v.astype(jnp.float32))
 
 
-def _fa_backward(causal, scale, block_k, res, do):
+def _fa_backward(causal, scale, block_k, res, do, dlse=None):
     """Flash backward: recompute scores per K block against the saved
-    logsumexp; never materializes [Tq, Tk]."""
+    logsumexp; never materializes [Tq, Tk].  `dlse` is the cotangent of
+    the logsumexp output (d lse/d s = p, so it folds into ds)."""
     q, k, v, o, lse = res
     qf = q.astype(jnp.float32)
     do = do.astype(jnp.float32)
     of = o.astype(jnp.float32)
     di = jnp.sum(do * of, axis=-1)  # [BH, T]
+    if dlse is not None:
+        di = di - dlse.astype(jnp.float32)  # ds += p * dlse
     tk = k.shape[1]
     bk = min(block_k, tk)
     nk = pl.cdiv(tk, bk)
@@ -191,25 +207,57 @@ def _fa_backward(causal, scale, block_k, res, do):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
+def _flash_with_lse(q, k, v, causal, scale, block_q, block_k):
+    """[BH, T, D] kernel entry returning (o, lse); differentiable —
+    the backward folds both cotangents into one flash recompute."""
     interpret = jax.default_backend() != 'tpu'
-    o, _ = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
+    return _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
                               interpret)
-    return o
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     interpret = jax.default_backend() != 'tpu'
     o, lse = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
                                 interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, do):
-    return _fa_backward(causal, scale, block_k, res, do)
+def _flash_bwd(causal, scale, block_q, block_k, res, cts):
+    do, dlse = cts
+    return _fa_backward(causal, scale, block_k, res, do, dlse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_with_lse.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _to_bhtd(q, k, v):
+    """[B, T, H, D] (or [BH, T, D] pass-through) -> flattened [B*H, T, D]
+    plus the info to restore — the single home of the layout contract."""
+    if q.ndim == 3:
+        return q, k, v, None
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    return qf, kf, vf, (b, h, tq, d)
+
+
+def attention_with_lse(q, k, v, causal=False, scale=None, block_q=128,
+                       block_k=128):
+    """Fused attention returning (o, lse) for online-softmax merging
+    (ring attention's local blocks).  q/k/v [B, T, H, D] -> o same shape,
+    lse [B, H, T].  Differentiable."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    qf, kf, vf, restore = _to_bhtd(q, k, v)
+    o, lse = _flash_with_lse(qf, kf, vf, bool(causal), float(scale),
+                             int(block_q), int(block_k))
+    if restore is None:
+        return o, lse
+    b, h, tq, d = restore
+    o = o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return o, lse.reshape(b, h, tq)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
@@ -225,14 +273,6 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         squeeze = True
     else:
         q4, k4, v4 = q, k, v
-    b, tq, h, d = q4.shape
-    tk = k4.shape[1]
-    if scale is None:
-        scale = float(d) ** -0.5
-    qf = q4.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kf = k4.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vf = v4.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    o = _flash(qf, kf, vf, bool(causal), float(scale), int(block_q),
-               int(block_k))
-    o = o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    o, _lse = attention_with_lse(q4, k4, v4, causal=causal, scale=scale,
+                                 block_q=block_q, block_k=block_k)
     return o[:, :, 0, :] if squeeze else o

@@ -14,7 +14,16 @@ __all__ = ['allreduce', 'allgather', 'reduce_scatter', 'broadcast',
            'ppermute', 'all_to_all', 'psum', 'pmean', 'pmax', 'pmin',
            'axis_index', 'axis_size', 'barrier', 'shard_map']
 
-from jax.experimental.shard_map import shard_map  # re-export
+import jax as _jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kwargs):
+    """jax.shard_map with the familiar positional signature.  Strict
+    replication (vma) checking stays ON — pallas calls inside mapped
+    functions annotate their outputs as axis-varying themselves
+    (ops/pallas/flash_attention._sds)."""
+    return _jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
 
 
 def psum(x, axis_name):

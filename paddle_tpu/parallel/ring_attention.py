@@ -51,18 +51,46 @@ def _merge(o1, m1, l1, o2, m2, l2):
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def _flash_local(q, k, v, scale):
+    """Local block via the fused Pallas kernel (ops/pallas): returns
+    online-softmax partials in _merge form — the normalized block output
+    with m := lse and l := 1 merges exactly (weights exp(lse_i - lse)).
+    Differentiable: attention_with_lse carries a custom flash-recompute
+    VJP that folds the lse cotangent from the merge weights back in."""
+    from ..ops.pallas.flash_attention import attention_with_lse
+    o, lse = attention_with_lse(q, k, v, scale=scale)
+    return o.astype(jnp.float32), lse, jnp.ones_like(lse)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   use_flash=False):
     """Exact attention with K/V sharded over `axis_name` (inside
     shard_map).  q/k/v: [B, T/sp, H, D] local shards; returns [B, T/sp,
-    H, D]."""
+    H, D].
+
+    use_flash=True computes each local block with the Pallas
+    online-softmax kernel (non-causal rings; the causal ring needs
+    per-offset masks the dense block path applies).  NOTE: call the
+    enclosing shard_map with check_vma=False — jax's varying-axes checker
+    does not yet see through interpret-mode pallas internals (its own
+    error message recommends exactly this workaround)."""
+    if use_flash and causal:
+        raise NotImplementedError(
+            "flash local blocks support non-causal rings; use the dense "
+            "block path for causal")
     sp = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     chunk = q.shape[1]
     q_off = rank * chunk
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    o0, m0, l0 = local_attention(q, k, v, scale=scale, causal=causal,
-                                 q_offset=q_off, k_offset=q_off)
+    def local(qb, kb, vb, k_off):
+        if use_flash:
+            return _flash_local(qb, kb, vb, scale)
+        return local_attention(qb, kb, vb, scale=scale, causal=causal,
+                               q_offset=q_off, k_offset=k_off)
+
+    o0, m0, l0 = local(q, k, v, q_off)
 
     def step(carry, i):
         o, m, l, kr, vr, k_owner = carry
@@ -70,8 +98,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         vr = lax.ppermute(vr, axis_name, perm)
         k_owner = (k_owner - 1) % sp
         k_off = k_owner * chunk
-        o2, m2, l2 = local_attention(q, kr, vr, scale=scale, causal=causal,
-                                     q_offset=q_off, k_offset=k_off)
+        o2, m2, l2 = local(q, kr, vr, k_off)
         o, m, l = _merge(o, m, l, o2, m2, l2)
         return (o, m, l, kr, vr, k_owner), None
 
