@@ -104,6 +104,19 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
     }
+    try:
+        # achieved compute rate from the compiler's own cost model
+        from paddle_tpu import profiler
+        flops = profiler.cost_analysis(
+            main_prog, {'img': images, 'label': labels},
+            [avg_cost]).get('flops', 0)
+        if flops:
+            result["achieved_tflops"] = round(
+                flops * steps / dt / 1e12, 2)
+    except Exception:
+        pass
+    result["config"] = "%s %s batch=%d feed=%s" % (dtype, layout, batch,
+                                                   feed_mode)
     if not on_tpu:
         result["note"] = "cpu-smoke (depth=%d hw=%d batch=%d)" % (
             depth, hw, batch)

@@ -80,8 +80,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _sds(shape, dtype):
-    """ShapeDtypeStruct annotated as varying over the ambient mapped axes
-    so a pallas_call inside shard_map passes strict vma checking."""
+    """ShapeDtypeStruct annotated as varying over the ambient mapped
+    axes.  This clears shard_map's out_shape vma requirement; pallas
+    -internal slice ops still trip the strict checker, so callers pass
+    check_vma=False on the enclosing shard_map (see
+    parallel/ring_attention.ring_attention)."""
     try:
         import jax.core as jc
         vma = frozenset(jc.unsafe_get_axis_names_DO_NOT_USE())

@@ -19,9 +19,10 @@ import jax as _jax
 
 def shard_map(f, mesh, in_specs, out_specs, **kwargs):
     """jax.shard_map with the familiar positional signature.  Strict
-    replication (vma) checking stays ON — pallas calls inside mapped
-    functions annotate their outputs as axis-varying themselves
-    (ops/pallas/flash_attention._sds)."""
+    replication (vma) checking is on by default; mapped functions that
+    call pallas kernels (e.g. ring_attention(use_flash=True)) must pass
+    check_vma=False — jax's vma checker does not yet see through
+    pallas-internal ops (its own error recommends that workaround)."""
     return _jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, **kwargs)
 
