@@ -1,0 +1,74 @@
+"""C9 — build-time shape/dtype inference.
+
+Reference parity: paddle/framework/shape_inference.h + per-op InferShape.
+Here every op's inference comes from ONE source of truth — jax.eval_shape
+over the op's compute function (core/infer.py) — so this suite checks the
+mechanism across representative op families plus the -1 batch sentinel.
+"""
+import paddle_tpu as fluid
+from paddle_tpu.core.infer import infer_outputs
+
+
+def _spec(shape, dtype='float32'):
+    return (tuple(shape), dtype)
+
+
+def test_conv_pool_shapes():
+    out = infer_outputs('conv2d',
+                        {'Input': [_spec((-1, 3, 32, 32))],
+                         'Filter': [_spec((16, 3, 3, 3))]},
+                        {'strides': [1, 1], 'paddings': [1, 1]},
+                        ['Output'])
+    assert out['Output'][0][0] == (-1, 16, 32, 32)
+    out = infer_outputs('pool2d', {'X': [_spec((-1, 16, 32, 32))]},
+                        {'ksize': [2, 2], 'pooling_type': 'max',
+                         'strides': [2, 2]}, ['Out'])
+    assert out['Out'][0][0] == (-1, 16, 16, 16)
+
+
+def test_matmul_and_softmax_shapes():
+    out = infer_outputs('mul', {'X': [_spec((-1, 64))],
+                                'Y': [_spec((64, 10))]}, {}, ['Out'])
+    assert out['Out'][0][0] == (-1, 10)
+    out = infer_outputs('softmax', {'X': [_spec((-1, 10))]}, {}, ['Out'])
+    assert out['Out'][0][0] == (-1, 10)
+
+
+def test_sequence_and_rnn_shapes():
+    out = infer_outputs('sequence_pool',
+                        {'X': [_spec((-1, 20, 8))]},
+                        {'pooltype': 'AVERAGE'}, ['Out'])
+    assert out['Out'][0][0] == (-1, 8)
+    out = infer_outputs('lstm',
+                        {'Input': [_spec((-1, 20, 64))],
+                         'Weight': [_spec((16, 64))]},
+                        {'use_peepholes': False}, ['Hidden', 'Cell'])
+    assert out['Hidden'][0][0] == (-1, 20, 16)
+    assert out['Cell'][0][0] == (-1, 20, 16)
+
+
+def test_dtype_inference():
+    out = infer_outputs('cast', {'X': [_spec((4, 4))]},
+                        {'out_dtype': 'int32'}, ['Out'])
+    assert out['Out'][0][1] in ('int32', 'INT32') or \
+        'int32' in str(out['Out'][0][1])
+    out = infer_outputs('equal', {'X': [_spec((4,))],
+                                  'Y': [_spec((4,))]}, {}, ['Out'])
+    assert 'bool' in str(out['Out'][0][1]).lower()
+
+
+def test_layer_vars_get_inferred_shapes():
+    """The LayerHelper wires inference into every append_op: built vars
+    carry concrete symbolic shapes."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        conv = fluid.layers.conv2d(input=img, num_filters=8,
+                                   filter_size=3, padding=1)
+        pool = fluid.layers.pool2d(input=conv, pool_size=2, pool_stride=2)
+        fc = fluid.layers.fc(input=pool, size=10)
+    assert tuple(conv.shape)[1:] == (8, 32, 32)
+    assert tuple(pool.shape)[1:] == (8, 16, 16)
+    assert tuple(fc.shape)[1:] == (10,)

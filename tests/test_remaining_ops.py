@@ -1,0 +1,103 @@
+"""Direct tests for the remaining op tail: 3-D conv/pool, lod structure
+ops, assigns/fills, reduce_prod.
+
+Reference parity: python/paddle/v2/fluid/tests/test_{conv3d,pool3d,
+split_and_merge_lod_tensor,shrink_rnn_memory,lod_rank_table}_op.py.
+"""
+import numpy as np
+
+from op_test import run_op
+
+rng = np.random.RandomState(53)
+
+
+def test_conv3d_shape_and_value():
+    x = rng.randn(1, 2, 4, 4, 4).astype('float32')
+    w = rng.randn(3, 2, 2, 2, 2).astype('float32')
+    got = np.asarray(run_op('conv3d', {'Input': x, 'Filter': w},
+                            {'strides': [1, 1, 1],
+                             'paddings': [0, 0, 0]})['Output'][0])
+    assert got.shape == (1, 3, 3, 3, 3)
+    # check one output element against the direct correlation
+    want = np.sum(x[0, :, :2, :2, :2] * w[0])
+    np.testing.assert_allclose(got[0, 0, 0, 0, 0], want, rtol=1e-4)
+
+
+def test_conv3d_transpose_shape():
+    x = rng.randn(1, 3, 3, 3, 3).astype('float32')
+    w = rng.randn(3, 2, 2, 2, 2).astype('float32')  # (in, out, k, k, k)
+    got = np.asarray(run_op('conv3d_transpose',
+                            {'Input': x, 'Filter': w},
+                            {'strides': [1, 1, 1],
+                             'paddings': [0, 0, 0]})['Output'][0])
+    assert got.shape == (1, 2, 4, 4, 4)
+
+
+def test_pool3d():
+    x = rng.randn(1, 2, 4, 4, 4).astype('float32')
+    got = np.asarray(run_op('pool3d', {'X': x},
+                            {'ksize': [2, 2, 2], 'strides': [2, 2, 2],
+                             'pooling_type': 'max'})['Out'][0])
+    assert got.shape == (1, 2, 2, 2, 2)
+    np.testing.assert_allclose(got[0, 0, 0, 0, 0],
+                               x[0, 0, :2, :2, :2].max(), rtol=1e-6)
+
+
+def test_assign_and_fills():
+    x = rng.randn(3, 2).astype('float32')
+    np.testing.assert_allclose(
+        np.asarray(run_op('assign', {'X': x})['Out'][0]), x)
+    got = np.asarray(run_op('assign_value', {}, {
+        'values': [1.0, 2.0, 3.0, 4.0], 'shape': [2, 2],
+        'dtype': 'float32'})['Out'][0])
+    np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+    got = np.asarray(run_op('fill', {}, {
+        'value': [5.0, 6.0], 'shape': [2], 'dtype': 'float32'})['Out'][0])
+    np.testing.assert_allclose(got, [5, 6])
+
+
+def test_reduce_prod_and_sign_of():
+    x = np.array([[1.0, 2.0, 3.0], [0.5, -2.0, 1.0]], dtype='float32')
+    got = np.asarray(run_op('reduce_prod', {'X': x}, {'dim': 1})['Out'][0])
+    np.testing.assert_allclose(got, [6.0, -1.0], rtol=1e-5)
+    s = np.asarray(run_op('sign_of', {'X': x})['Out'][0])
+    np.testing.assert_array_equal(s, np.sign(x))
+
+
+def test_lod_array_roundtrip_and_rank_table():
+    x = rng.randn(3, 5, 2).astype('float32')  # [B, T, D]
+    lengths = np.array([5, 2, 4], dtype='int64')
+    arr = run_op('lod_tensor_to_array', {'X': x})['Out'][0]
+    assert np.asarray(arr.data).shape == (5, 3, 2)  # [T, B, D]
+    back = np.asarray(run_op('array_to_lod_tensor',
+                             {'X': [arr]})['Out'][0])
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+    table = np.asarray(run_op('lod_rank_table',
+                              {'X': x, 'XLen': lengths})['Out'][0])
+    np.testing.assert_array_equal(table, lengths)
+    mx = np.asarray(run_op('max_sequence_len',
+                           {'RankTable': table})['Out'][0])
+    assert int(np.ravel(mx)[0]) == 5
+
+
+def test_shrink_rnn_memory():
+    x = rng.randn(3, 4).astype('float32')
+    table = np.array([3, 1, 2], dtype='int32')  # lengths per row
+    got = np.asarray(run_op('shrink_rnn_memory',
+                            {'X': x, 'RankTable': table,
+                             'I': np.array([1], 'int64')})['Out'][0])
+    # step 1: rows with length > 1 stay, others zero
+    np.testing.assert_allclose(got[0], x[0], rtol=1e-6)
+    assert np.all(got[1] == 0)
+    np.testing.assert_allclose(got[2], x[2], rtol=1e-6)
+
+
+def test_split_and_merge_lod_tensor():
+    x = rng.randn(4, 3).astype('float32')
+    mask = np.array([[1], [0], [1], [0]], dtype='bool')
+    outs = run_op('split_lod_tensor', {'X': x, 'Mask': mask})
+    merged = run_op('merge_lod_tensor',
+                    {'InTrue': outs['OutTrue'][0],
+                     'InFalse': outs['OutFalse'][0],
+                     'Mask': mask, 'X': x})['Out'][0]
+    np.testing.assert_allclose(np.asarray(merged), x, rtol=1e-6)
