@@ -104,7 +104,7 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
     }
-    if os.environ.get('PADDLE_TPU_BENCH_TFLOPS'):
+    if os.environ.get('PADDLE_TPU_BENCH_TFLOPS') not in (None, '', '0'):
         # achieved compute rate from the compiler's own cost model —
         # opt-in: cost_analysis compiles a second copy of the step
         # (~30s on TPU; Lowered.cost_analysis is None on this backend)

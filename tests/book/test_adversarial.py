@@ -1,0 +1,49 @@
+"""M12 — the FGSM MNIST tutorial as an end-to-end book test.
+
+Reference parity: adversarial/mnist_tutorial_fgsm.py (train fluid_mnist,
+wrap in PaddleModel, flip predictions with GradientSignAttack).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.adversarial import FGSM, PaddleModel
+from paddle_tpu.models import mnist
+
+
+def test_fgsm_mnist_tutorial():
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 17
+    startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        img, label, predict, avg_cost, acc = mnist.build('mlp')
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # learnable clusters so the model has real decision boundaries
+    rng = np.random.RandomState(0)
+    centers = rng.randn(10, 1, 28, 28).astype('float32')
+    for _ in range(30):
+        lab = rng.randint(0, 10, (64, 1)).astype('int64')
+        imgs = centers[lab[:, 0]] + \
+            0.1 * rng.randn(64, 1, 28, 28).astype('float32')
+        exe.run(main, feed={'img': imgs, 'label': lab},
+                fetch_list=[avg_cost])
+
+    model = PaddleModel(test_prog, img.name, label.name, predict.name,
+                        avg_cost.name, bounds=(-4, 4))
+    lab = np.array([[3]], dtype='int64')
+    x = (centers[3] + 0.05 * rng.randn(1, 28, 28)).astype(
+        'float32')[None]
+    clean_pred = int(np.argmax(model.predict(x), axis=-1)[0])
+    assert clean_pred == 3  # trained model classifies the cluster
+
+    adv = FGSM(model)(x, lab)
+    assert adv is not None, 'FGSM found no adversarial example'
+    adv_pred = int(np.argmax(model.predict(adv), axis=-1)[0])
+    assert adv_pred != clean_pred
+    # perturbation stays within the valid pixel range
+    assert adv.min() >= -4 and adv.max() <= 4
