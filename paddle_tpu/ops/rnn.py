@@ -41,11 +41,30 @@ def _lstm(ctx, ins, attrs):
     c0 = first(ins, 'C0')
     b, t, fourh = x.shape
     h = fourh // 4
+    use_peepholes = attrs.get('use_peepholes', True) and bias is not None \
+        and bias.shape[-1] == 7 * h
+
+    if attrs.get('use_pallas') and lengths is None and h0 is None and \
+            c0 is None and not attrs.get('is_reverse', False) and \
+            attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
+            attrs.get('cell_activation', 'tanh') == 'tanh' and \
+            attrs.get('candidate_activation', 'tanh') == 'tanh' and \
+            not use_peepholes:
+        # fused Pallas time loop (ops/pallas/lstm_cell.py): carry lives
+        # in VMEM across grid steps; falls back to the lax.scan path for
+        # ragged/reversed/peephole/custom-activation configs
+        from .pallas.lstm_cell import lstm_scan
+        xf = x.astype(jnp.float32)
+        if bias is not None:
+            xf = xf + bias.astype(jnp.float32)[..., :4 * h].reshape(
+                1, 1, -1)
+        # kernel gate order (i, f, cand, o) == this op's (i, f, c, o)
+        hs, cs = lstm_scan(jnp.swapaxes(xf, 0, 1), w)
+        return {'Hidden': [jnp.swapaxes(hs, 0, 1).astype(x.dtype)],
+                'Cell': [jnp.swapaxes(cs, 0, 1).astype(x.dtype)]}
     if lengths is None:
         lengths = jnp.full((b,), t, jnp.int32)
     lengths = lengths.astype(jnp.int32).reshape(-1)
-    use_peepholes = attrs.get('use_peepholes', True) and bias is not None \
-        and bias.shape[-1] == 7 * h
     gate_act = _gate_act(attrs.get('gate_activation', 'sigmoid'))
     cell_act = _gate_act(attrs.get('cell_activation', 'tanh'))
     cand_act = _gate_act(attrs.get('candidate_activation', 'tanh'))

@@ -6,13 +6,13 @@ long-sequence context parallel (D5), the NCCL/MPI collective backend (D6)
 — is expressed here as a sharding over ONE `jax.sharding.Mesh` with named
 axes; XLA lowers the named-axis collectives onto ICI.
 """
-from . import api, collective, data_parallel, pipeline, ring_attention, \
-    tensor_parallel
+from . import api, collective, data_parallel, expert_parallel, pipeline, \
+    ring_attention, tensor_parallel
 from .api import (current_mesh, make_mesh, mesh_guard, run_sharded,
                   shard_tensor)
 
 __all__ = [
     'api', 'collective', 'data_parallel', 'tensor_parallel', 'pipeline',
-    'ring_attention', 'make_mesh', 'mesh_guard', 'current_mesh',
-    'shard_tensor', 'run_sharded',
+    'ring_attention', 'expert_parallel', 'make_mesh', 'mesh_guard',
+    'current_mesh', 'shard_tensor', 'run_sharded',
 ]

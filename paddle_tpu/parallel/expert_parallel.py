@@ -66,13 +66,11 @@ def combine(y, state, axis_name):
     idx, valid, expert, keep, pos = state
     back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
                           tiled=False)  # [E, C, D] expert-indexed again
-    t = idx.shape[0] * 0 + keep.shape[0]
     d = y.shape[-1]
-    out = jnp.zeros((keep.shape[0], d), y.dtype)
     flat = back.reshape(-1, d)  # [E*C, D]
     slot = expert * idx.shape[1] + pos  # token's slot if kept
     gathered = flat[jnp.minimum(slot, flat.shape[0] - 1)]
-    return jnp.where(keep[:, None], gathered, out)
+    return jnp.where(keep[:, None], gathered, 0.0).astype(y.dtype)
 
 
 def expert_ffn(x, w1, b1, w2, b2):
