@@ -178,9 +178,17 @@ def lod_reset(x, y=None, target_lod=None, **kwargs):
 def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
                  use_peepholes=True, is_reverse=False,
                  gate_activation='sigmoid', cell_activation='tanh',
-                 candidate_activation='tanh', dtype='float32', **kwargs):
+                 candidate_activation='tanh', dtype='float32',
+                 use_pallas=False, **kwargs):
     """Parity with fluid.layers.dynamic_lstm: `input` is the pre-projected
-    gate sequence [B, T, 4H] (from an fc of size 4*hidden)."""
+    gate sequence [B, T, 4H] (from an fc of size 4*hidden).
+
+    use_pallas=True requests the fused VMEM-carry time-loop kernel
+    (ops/pallas/lstm_cell.py) — engaged on the TPU backend when the
+    config qualifies (full-length, forward, default activations, no
+    peepholes).  Best for inference/forward-heavy use: the backward
+    recomputes the scan formulation, so pure training steps gain
+    little over the default path."""
     helper = LayerHelper('lstm', **kwargs)
     hidden = size // 4
     from ..param_attr import ParamAttr
@@ -201,7 +209,8 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
         attrs={'use_peepholes': use_peepholes, 'is_reverse': is_reverse,
                'gate_activation': gate_activation,
                'cell_activation': cell_activation,
-               'candidate_activation': candidate_activation})
+               'candidate_activation': candidate_activation,
+               'use_pallas': use_pallas})
     _copy_len(helper, input, hidden_out)
     _copy_len(helper, input, cell_out)
     return hidden_out, cell_out

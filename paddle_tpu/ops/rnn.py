@@ -44,20 +44,23 @@ def _lstm(ctx, ins, attrs):
     use_peepholes = attrs.get('use_peepholes', True) and bias is not None \
         and bias.shape[-1] == 7 * h
 
+    xf = x.astype(jnp.float32)
+    if bias is not None:
+        xf = xf + bias.astype(jnp.float32)[..., :4 * h].reshape(1, 1, -1)
+
     if attrs.get('use_pallas') and lengths is None and h0 is None and \
             c0 is None and not attrs.get('is_reverse', False) and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('cell_activation', 'tanh') == 'tanh' and \
             attrs.get('candidate_activation', 'tanh') == 'tanh' and \
-            not use_peepholes:
+            not use_peepholes and \
+            (jax.default_backend() == 'tpu' or
+             attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.py): carry lives
-        # in VMEM across grid steps; falls back to the lax.scan path for
-        # ragged/reversed/peephole/custom-activation configs
+        # in VMEM across grid steps.  TPU-only (interpret mode would
+        # unroll all T steps); falls back to the lax.scan path for
+        # ragged/reversed/peephole/custom-activation configs.
         from .pallas.lstm_cell import lstm_scan
-        xf = x.astype(jnp.float32)
-        if bias is not None:
-            xf = xf + bias.astype(jnp.float32)[..., :4 * h].reshape(
-                1, 1, -1)
         # kernel gate order (i, f, cand, o) == this op's (i, f, c, o)
         hs, cs = lstm_scan(jnp.swapaxes(xf, 0, 1), w)
         return {'Hidden': [jnp.swapaxes(hs, 0, 1).astype(x.dtype)],
@@ -70,9 +73,6 @@ def _lstm(ctx, ins, attrs):
     cand_act = _gate_act(attrs.get('candidate_activation', 'tanh'))
     is_reverse = attrs.get('is_reverse', False)
 
-    xf = x.astype(jnp.float32)
-    if bias is not None:
-        xf = xf + bias.astype(jnp.float32)[..., :4 * h].reshape(1, 1, -1)
     if use_peepholes:
         bf = bias.astype(jnp.float32).reshape(-1)
         w_ic, w_fc, w_oc = (bf[4 * h:5 * h], bf[5 * h:6 * h],

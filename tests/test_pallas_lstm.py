@@ -60,7 +60,8 @@ def test_lstm_op_use_pallas_attr():
     base = run_op('lstm', {'Input': x, 'Weight': w, 'Bias': bias},
                   {'use_peepholes': False})
     fused = run_op('lstm', {'Input': x, 'Weight': w, 'Bias': bias},
-                   {'use_peepholes': False, 'use_pallas': True})
+                   {'use_peepholes': False, 'use_pallas': True,
+                    'pallas_interpret': True})  # engage off-TPU in CI
     np.testing.assert_allclose(np.asarray(fused['Hidden'][0]),
                                np.asarray(base['Hidden'][0]),
                                rtol=1e-4, atol=1e-5)
