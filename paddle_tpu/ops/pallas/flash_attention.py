@@ -16,6 +16,8 @@ code path is exercised by CPU CI.
 """
 import functools
 
+import numpy as _np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -26,8 +28,9 @@ __all__ = ['flash_attention']
 _NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-               *, scale, causal, block_q, block_k, nk, tk):
+def _fa_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+               m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+               nk, tk):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -46,9 +49,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         jnp.int32, (block_q, block_k), 1)
     valid = kpos < tk  # last block may be padding past the real length
     if causal:
+        # global positions: scalar-prefetched offsets shift the local
+        # indices, so causal masking works across ring-rotated K blocks
         qpos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        valid = valid & (qpos >= kpos)
+        valid = valid & ((qoff_ref[0] + qpos) >= (koff_ref[0] + kpos))
     s = jnp.where(valid, s, _NEG_INF)
 
     m_prev = m_scr[:, 0]  # [bq]
@@ -95,8 +100,12 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
-    """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T])."""
+def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                q_offset=None, k_offset=None):
+    """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T]).  Optional traced
+    q_offset/k_offset (int32 scalars, scalar-prefetched into SMEM) shift
+    the causal mask's global positions — the hook ring attention uses to
+    run causal flash blocks against rotated K/V shards."""
     bh, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)
@@ -115,36 +124,43 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, nk=nk,
                                tk=tk)
-    return pl.pallas_call(
-        kernel,
+    qoff = jnp.asarray([0 if q_offset is None else q_offset], jnp.int32)
+    koff = jnp.asarray([0 if k_offset is None else k_offset], jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            _sds((bh, tq_p, d), q.dtype),
-            _sds((bh, tq_p, 128), jnp.float32),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda b, i, j, *_: (b, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((bh, tq_p, d), q.dtype),
+            _sds((bh, tq_p, 128), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v)
+    )(qoff, koff, q, k, v)
 
 
 def _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
-                       interpret):
+                       interpret, q_offset=None, k_offset=None):
     tq = q.shape[1]
     o, lse = _fa_forward(q, k, v, causal, scale, block_q, block_k,
-                         interpret)
+                         interpret, q_offset, k_offset)
     return o[:, :tq], lse[:, :tq, 0]
 
 
@@ -163,7 +179,7 @@ def _fa_backward(causal, scale, block_k, res, do, dlse=None):
     """Flash backward: recompute scores per K block against the saved
     logsumexp; never materializes [Tq, Tk].  `dlse` is the cotangent of
     the logsumexp output (d lse/d s = p, so it folds into ds)."""
-    q, k, v, o, lse = res
+    q, k, v, q_off, k_off, o, lse = res
     qf = q.astype(jnp.float32)
     do = do.astype(jnp.float32)
     of = o.astype(jnp.float32)
@@ -178,7 +194,7 @@ def _fa_backward(causal, scale, block_k, res, do, dlse=None):
     vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
     kpos0 = jnp.arange(nk) * bk
     tq = q.shape[1]
-    qpos = jnp.arange(tq)
+    qpos = q_off + jnp.arange(tq)
 
     def kblock(carry, inp):
         dq_acc = carry
@@ -189,7 +205,8 @@ def _fa_backward(causal, scale, block_k, res, do, dlse=None):
         kpos = k0 + jnp.arange(bk)
         valid = (kpos < tk)[None, None, :]
         if causal:
-            valid = valid & (qpos[:, None] >= kpos[None, :])[None]
+            valid = valid & (qpos[:, None] >=
+                             (k_off + kpos)[None, :])[None]
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse[:, :, None])  # [BH, Tq, bk]
         p = jnp.where(valid, p, 0.0)
@@ -209,25 +226,29 @@ def _fa_backward(causal, scale, block_k, res, do, dlse=None):
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_with_lse(q, k, v, causal, scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_with_lse(q, k, v, q_off, k_off, causal, scale, block_q,
+                    block_k):
     """[BH, T, D] kernel entry returning (o, lse); differentiable —
-    the backward folds both cotangents into one flash recompute."""
+    the backward folds both cotangents into one flash recompute.
+    q_off/k_off are traced int32 scalars shifting the causal mask."""
     interpret = jax.default_backend() != 'tpu'
     return _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
+                              interpret, q_off, k_off)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, q_off, k_off, causal, scale, block_q, block_k):
     interpret = jax.default_backend() != 'tpu'
     o, lse = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
-                                interpret)
-    return (o, lse), (q, k, v, o, lse)
+                                interpret, q_off, k_off)
+    return (o, lse), (q, k, v, q_off, k_off, o, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, res, cts):
     do, dlse = cts
-    return _fa_backward(causal, scale, block_k, res, do, dlse)
+    dq, dk, dv = _fa_backward(causal, scale, block_k, res, do, dlse)
+    f0 = _np.zeros((), jax.dtypes.float0)  # int operands: zero cotangent
+    return dq, dk, dv, f0, f0
 
 
 _flash_with_lse.defvjp(_flash_fwd, _flash_bwd)
@@ -247,15 +268,19 @@ def _to_bhtd(q, k, v):
 
 
 def attention_with_lse(q, k, v, causal=False, scale=None, block_q=128,
-                       block_k=128):
+                       block_k=128, q_offset=0, k_offset=0):
     """Fused attention returning (o, lse) for online-softmax merging
     (ring attention's local blocks).  q/k/v [B, T, H, D] -> o same shape,
-    lse [B, H, T].  Differentiable."""
+    lse [B, H, T].  Differentiable.  q_offset/k_offset (traced int ok)
+    place the local blocks on the global sequence axis for causal
+    masking across ring-rotated K/V shards."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     qf, kf, vf, restore = _to_bhtd(q, k, v)
-    o, lse = _flash_with_lse(qf, kf, vf, bool(causal), float(scale),
-                             int(block_q), int(block_k))
+    qo = jnp.asarray(q_offset, jnp.int32)
+    ko = jnp.asarray(k_offset, jnp.int32)
+    o, lse = _flash_with_lse(qf, kf, vf, qo, ko, bool(causal),
+                             float(scale), int(block_q), int(block_k))
     if restore is None:
         return o, lse
     b, h, tq, d = restore

@@ -51,14 +51,18 @@ def _merge(o1, m1, l1, o2, m2, l2):
     return o, m, l
 
 
-def _flash_local(q, k, v, scale):
+def _flash_local(q, k, v, scale, causal, q_off, k_off):
     """Local block via the fused Pallas kernel (ops/pallas): returns
     online-softmax partials in _merge form — the normalized block output
     with m := lse and l := 1 merges exactly (weights exp(lse_i - lse)).
     Differentiable: attention_with_lse carries a custom flash-recompute
-    VJP that folds the lse cotangent from the merge weights back in."""
+    VJP that folds the lse cotangent from the merge weights back in.
+    Causal masking uses the scalar-prefetched global offsets, so it is
+    exact against ring-rotated K/V shards; fully-masked rows come back
+    with lse=-inf-like values and zero out in the merge."""
     from ..ops.pallas.flash_attention import attention_with_lse
-    o, lse = attention_with_lse(q, k, v, scale=scale)
+    o, lse = attention_with_lse(q, k, v, scale=scale, causal=causal,
+                                q_offset=q_off, k_offset=k_off)
     return o.astype(jnp.float32), lse, jnp.ones_like(lse)
 
 
@@ -69,15 +73,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     H, D].
 
     use_flash=True computes each local block with the Pallas
-    online-softmax kernel (non-causal rings; the causal ring needs
-    per-offset masks the dense block path applies).  NOTE: call the
-    enclosing shard_map with check_vma=False — jax's varying-axes checker
-    does not yet see through interpret-mode pallas internals (its own
-    error message recommends exactly this workaround)."""
-    if use_flash and causal:
-        raise NotImplementedError(
-            "flash local blocks support non-causal rings; use the dense "
-            "block path for causal")
+    online-softmax kernel (causal included — global offsets ride scalar
+    prefetch).  NOTE: call the enclosing shard_map with check_vma=False
+    — jax's varying-axes checker does not yet see through interpret-mode
+    pallas internals (its own error message recommends exactly this
+    workaround)."""
     sp = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     chunk = q.shape[1]
@@ -85,10 +85,26 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def local(qb, kb, vb, k_off):
-        if use_flash:
-            return _flash_local(qb, kb, vb, scale)
-        return local_attention(qb, kb, vb, scale=scale, causal=causal,
-                               q_offset=q_off, k_offset=k_off)
+        if not use_flash:
+            return local_attention(qb, kb, vb, scale=scale, causal=causal,
+                                   q_offset=q_off, k_offset=k_off)
+        if not causal:
+            return _flash_local(qb, kb, vb, scale, False, q_off, k_off)
+        # causal ring: a block entirely in the future (k_off past this
+        # shard's last query) contributes zero weight — skip its kernel
+        # (~half the local compute at large sp) and emit the neutral
+        # partials (_merge weight exp(-1e30 - m) = 0) directly
+        b_, tq_, h_, _ = qb.shape
+
+        def masked_block(_):
+            lse = jnp.full((b_, h_, tq_), -1e30, jnp.float32)
+            return (jnp.zeros(qb.shape[:3] + (vb.shape[-1],),
+                              jnp.float32), lse, jnp.ones_like(lse))
+
+        return lax.cond(
+            k_off > q_off + tq_ - 1, masked_block,
+            lambda _: _flash_local(qb, kb, vb, scale, True, q_off,
+                                   k_off), None)
 
     o0, m0, l0 = local(q, k, v, q_off)
 

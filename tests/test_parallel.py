@@ -155,9 +155,10 @@ def test_ring_attention_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
-def test_ring_attention_flash_local_matches_dense():
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_attention_flash_local_matches_dense(causal):
     """D5: ring attention with Pallas flash local blocks (interpret on
-    CPU) == dense attention."""
+    CPU) == dense attention — incl. causal via scalar-prefetch offsets."""
     need_devices(4)
     sp = 4
     mesh = api.make_mesh((sp,), ('sp',))
@@ -168,12 +169,15 @@ def test_ring_attention_flash_local_matches_dense():
     v = rng.normal(size=(B, T, H, D)).astype(np.float32)
     scale = D ** -0.5
     s = np.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    if causal:
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -1e30)
     p = np.exp(s - s.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
     ref = np.einsum('bhqk,bkhd->bqhd', p, v)
 
     def f(q, k, v):
-        return ring_attention.ring_attention(q, k, v, 'sp',
+        return ring_attention.ring_attention(q, k, v, 'sp', causal=causal,
                                              use_flash=True)
 
     out = collective.shard_map(
@@ -184,10 +188,11 @@ def test_ring_attention_flash_local_matches_dense():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
-def test_ring_attention_flash_grads_match_dense():
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_attention_flash_grads_match_dense(causal):
     """use_flash ring must be differentiable and match dense-path grads
     (the lse cotangent from the merge weights flows through the kernel's
-    custom VJP)."""
+    custom VJP) — incl. causal offset masking and fully-masked blocks."""
     need_devices(4)
     sp = 4
     mesh = api.make_mesh((sp,), ('sp',))
@@ -199,7 +204,7 @@ def test_ring_attention_flash_grads_match_dense():
 
     def make_loss(use_flash):
         def f(q, k, v):
-            o = ring_attention.ring_attention(q, k, v, 'sp',
+            o = ring_attention.ring_attention(q, k, v, 'sp', causal=causal,
                                               use_flash=use_flash)
             return o
 
