@@ -1,0 +1,101 @@
+"""P5/P6 — gradient clip and weight-decay regularizers end-to-end.
+
+Reference parity: python/paddle/v2/fluid/tests/test_gradient_clip.py and
+test_regularizer.py — observed through their effect on the parameter
+update (the TPU build fuses clip/regularizer ops into the one-HLO step).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _one_step(clip=None, regularizer=None, lr=1.0, grad_scale=1000.0):
+    """Build y = w.x with a huge loss gradient; return |w_new - w_old|."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        p = fluid.layers.fc(
+            input=x, size=1,
+            param_attr=fluid.ParamAttr(name='w_clip',
+                                       regularizer=regularizer),
+            bias_attr=False)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        if clip is not None:
+            fluid.clip.set_gradient_clip(clip)
+        try:
+            fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+        finally:
+            fluid.clip.set_gradient_clip(None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    before = np.asarray(scope.find_var('w_clip')).copy()
+    feed = {'x': np.ones((2, 4), 'float32'),
+            'y': np.full((2, 1), grad_scale, 'float32')}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    after = np.asarray(scope.find_var('w_clip'))
+    return before, after
+
+
+def test_clip_by_global_norm_limits_update():
+    b0, a0 = _one_step(clip=None)
+    assert np.abs(a0 - b0).max() > 10  # unclipped: huge step
+    b1, a1 = _one_step(clip=fluid.clip.GradientClipByGlobalNorm(
+        clip_norm=0.1))
+    # ||delta|| = lr * ||clipped grad|| <= lr * clip_norm
+    assert np.linalg.norm(a1 - b1) <= 0.1 + 1e-5
+
+
+def test_clip_by_value_limits_each_component():
+    b, a = _one_step(clip=fluid.clip.GradientClipByValue(max=0.05,
+                                                         min=-0.05))
+    assert np.abs(a - b).max() <= 0.05 + 1e-6
+
+
+def test_clip_by_norm_limits_update():
+    b, a = _one_step(clip=fluid.clip.GradientClipByNorm(clip_norm=0.2))
+    assert np.linalg.norm(a - b) <= 0.2 + 1e-5
+
+
+@pytest.mark.parametrize('reg_cls,reg_name',
+                         [(fluid.regularizer.L2Decay, 'l2'),
+                          (fluid.regularizer.L1Decay, 'l1')])
+def test_regularizer_shrinks_weights(reg_cls, reg_name):
+    """With zero data gradient (y == prediction), the only update is the
+    decay term: w moves toward zero."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        p = fluid.layers.fc(
+            input=x, size=1,
+            param_attr=fluid.ParamAttr(name='w_reg_' + reg_name,
+                                       regularizer=reg_cls(0.1)),
+            bias_attr=False)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    name = 'w_reg_' + reg_name
+    w0 = np.asarray(scope.find_var(name)).copy()
+    xb = np.zeros((2, 4), 'float32')  # zero input -> zero data grad
+    exe.run(main, feed={'x': xb, 'y': np.zeros((2, 1), 'float32')},
+            fetch_list=[loss])
+    w1 = np.asarray(scope.find_var(name))
+    if reg_name == 'l2':
+        np.testing.assert_allclose(w1, w0 * (1 - 0.5 * 0.1), rtol=1e-4)
+    else:
+        np.testing.assert_allclose(w1, w0 - 0.5 * 0.1 * np.sign(w0),
+                                   rtol=1e-4, atol=1e-6)
+    assert np.abs(w1).sum() < np.abs(w0).sum()

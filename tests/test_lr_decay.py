@@ -1,0 +1,112 @@
+"""P3 — LR decay schedules vs their closed-form formulas over steps.
+
+Reference parity: python/paddle/v2/fluid/tests/test_learning_rate_decay.py
+(exponential/natural_exp/inverse_time/polynomial/piecewise).  The step
+counter increments once per executor run, so fetching the LR var across
+runs traces the whole schedule.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import learning_rate_decay as lrd
+
+BASE, DECAY_STEPS, RATE = 1.0, 5, 0.5
+
+
+def _trajectory(build, steps=12):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return [float(np.ravel(exe.run(main, fetch_list=[lr])[0])[0])
+            for _ in range(steps)]
+
+
+@pytest.mark.parametrize('staircase', [False, True])
+def test_exponential_decay(staircase):
+    got = _trajectory(lambda: lrd.exponential_decay(
+        BASE, DECAY_STEPS, RATE, staircase))
+    for i, v in enumerate(got):
+        step = i + 1  # counter begins at 1
+        d = step / DECAY_STEPS
+        if staircase:
+            d = np.floor(d)
+        np.testing.assert_allclose(v, BASE * RATE ** d, rtol=1e-5,
+                                   err_msg='step %d' % step)
+
+
+def test_natural_exp_decay():
+    got = _trajectory(lambda: lrd.natural_exp_decay(
+        BASE, DECAY_STEPS, RATE))
+    for i, v in enumerate(got):
+        step = i + 1
+        np.testing.assert_allclose(
+            v, BASE * np.exp(-RATE * step / DECAY_STEPS), rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    got = _trajectory(lambda: lrd.inverse_time_decay(
+        BASE, DECAY_STEPS, RATE))
+    for i, v in enumerate(got):
+        step = i + 1
+        np.testing.assert_allclose(
+            v, BASE / (1 + RATE * step / DECAY_STEPS), rtol=1e-5)
+
+
+@pytest.mark.parametrize('cycle', [False, True])
+def test_polynomial_decay(cycle):
+    end, power = 0.1, 2.0
+    got = _trajectory(lambda: lrd.polynomial_decay(
+        BASE, DECAY_STEPS, end, power, cycle))
+    for i, v in enumerate(got):
+        step = i + 1
+        if cycle:
+            periods = max(1.0, np.ceil(step / DECAY_STEPS))
+            frac = step / (periods * DECAY_STEPS)
+        else:
+            frac = min(step, DECAY_STEPS) / DECAY_STEPS
+        want = (BASE - end) * (1 - frac) ** power + end
+        np.testing.assert_allclose(v, want, rtol=1e-5,
+                                   err_msg='step %d' % step)
+
+
+def test_piecewise_decay():
+    got = _trajectory(lambda: lrd.piecewise_decay(
+        boundaries=[3, 7], values=[1.0, 0.5, 0.1]), steps=10)
+    want = [1.0 if s < 3 else 0.5 if s < 7 else 0.1
+            for s in range(1, 11)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_decay_drives_sgd_updates():
+    """The decayed LR actually reaches the optimizer op: with decay the
+    param moves less at later steps."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        p = fluid.layers.fc(input=x, size=1, param_attr='w_lr')
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGDOptimizer(
+            learning_rate=lrd.exponential_decay(0.5, 2, 0.1)
+        ).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(8, 4).astype('float32'),
+            'y': rng.randn(8, 1).astype('float32')}
+    scope = fluid.global_scope()
+    deltas = []
+    for _ in range(6):
+        before = np.asarray(scope.find_var('w_lr')).copy()
+        exe.run(main, feed=feed, fetch_list=[loss])
+        deltas.append(np.abs(np.asarray(scope.find_var('w_lr')) -
+                             before).max())
+    assert deltas[-1] < deltas[0] * 0.2  # LR collapsed by ~10x
