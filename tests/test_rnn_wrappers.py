@@ -1,0 +1,123 @@
+"""StaticRNN / DynamicRNN / IfElse layer wrappers.
+
+Reference parity: python/paddle/v2/fluid/tests/test_recurrent_op.py and
+test_dyn_rnn.py — the step-block APIs lowered to one lax.scan.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_static_rnn_accumulator():
+    """Memory carries a running sum across steps: out[t] = sum x[:t+1]."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[5, 3], dtype='float32')
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[-1, 3], batch_ref=x)
+            acc = fluid.layers.elementwise_add(x=mem, y=xt)
+            rnn.update_memory(mem, acc)
+            rnn.step_output(acc)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(2, 5, 3).astype('float32')
+    got, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    want = np.cumsum(xv, axis=1)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_static_rnn_with_params_trains():
+    """A learned RNN cell inside StaticRNN trains end-to-end."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6, 4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[-1, 8], batch_ref=x)
+            h = fluid.layers.fc(input=[xt, mem], size=8, act='tanh')
+            rnn.update_memory(mem, h)
+            rnn.step_output(h)
+        hs = rnn()
+        last = fluid.layers.sequence_last_step(input=hs)
+        pred = fluid.layers.fc(input=last, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(1)
+    feed = {'x': r.randn(4, 6, 4).astype('float32'),
+            'y': r.randn(4, 1).astype('float32')}
+    ls = [float(np.ravel(exe.run(main, feed=feed,
+                                 fetch_list=[loss])[0])[0])
+          for _ in range(10)]
+    assert ls[-1] < ls[0] * 0.7
+
+
+def test_dynamic_rnn_masks_ragged_rows():
+    """DynamicRNN over ragged rows: outputs zero past each row's length
+    and the memory freezes (mask semantics == reference shrink)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        # lod_level=1 data shapes are PER-STEP: [B, T, 2] at runtime
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32',
+                              lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            mem = drnn.memory(shape=[2])
+            acc = fluid.layers.elementwise_add(x=mem, y=xt)
+            drnn.update_memory(mem, acc)
+            drnn.output(acc)
+        out = drnn()
+        last = fluid.layers.sequence_last_step(input=out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((2, 4, 2), 'float32')
+    lengths = np.array([4, 2], 'int32')
+    got, last_v = exe.run(main, feed={'x': (xv, lengths)},
+                          fetch_list=[out, last])
+    got = np.asarray(got)
+    # row 0: cumsum over all 4 steps
+    np.testing.assert_allclose(got[0, :, 0], [1, 2, 3, 4], rtol=1e-6)
+    # row 1: valid through step 2, zeros after
+    np.testing.assert_allclose(got[1, :2, 0], [1, 2], rtol=1e-6)
+    assert np.all(got[1, 2:] == 0)
+    # the length-indexed final state reads the frozen value, not a
+    # continued accumulation (@LEN propagates through the RNN output)
+    np.testing.assert_allclose(np.asarray(last_v), [[4, 4], [2, 2]],
+                               rtol=1e-6)
+
+
+def test_ifelse_merges_rows():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32')
+        zero = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                          value=0.0)
+        cond = fluid.layers.less_than(x=x, y=zero)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            neg = ie.input(x)
+            ie.output(fluid.layers.scale(x=neg, scale=-1.0))
+        with ie.false_block():
+            pos = ie.input(x)
+            ie.output(fluid.layers.scale(x=pos, scale=1.0))
+        out = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[-2.0], [3.0], [-0.5], [4.0]], 'float32')
+    got, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.abs(xv), rtol=1e-6)
