@@ -17,11 +17,11 @@ from .layer_helper import LayerHelper
 from .tensor import fill_constant
 
 __all__ = [
-    'While', 'StaticRNN', 'DynamicRNN', 'IfElse', 'lod_rank_table',
-    'max_sequence_len', 'lod_tensor_to_array', 'array_to_lod_tensor',
-    'increment', 'array_write', 'create_array', 'array_read',
-    'array_length', 'shrink_memory', 'less_than', 'equal', 'Print',
-    'ParallelDo', 'split_lod_tensor', 'merge_lod_tensor',
+    'While', 'StaticRNN', 'DynamicRNN', 'IfElse', 'ConditionalBlock',
+    'lod_rank_table', 'max_sequence_len', 'lod_tensor_to_array',
+    'array_to_lod_tensor', 'increment', 'array_write', 'create_array',
+    'array_read', 'array_length', 'shrink_memory', 'less_than', 'equal',
+    'Print', 'ParallelDo', 'split_lod_tensor', 'merge_lod_tensor',
 ]
 
 from .tensor import less_than, equal  # re-export (fluid puts them here)
@@ -494,6 +494,50 @@ class IfElse(object):
         for t, f in zip(true_outs, false_outs):
             rets.append(select(self.cond, t, f))
         return rets[0] if len(rets) == 1 else rets
+
+
+class ConditionalBlock(object):
+    """fluid.layers.ConditionalBlock parity: ops built inside `block()`
+    execute under the scalar condition — on TPU both paths trace and the
+    written vars select by `cond` (operators/conditional_block_op.cc
+    scope semantics preserved by the select; no divergent control flow
+    reaches XLA)."""
+
+    def __init__(self, inputs, name=None):
+        # parity signature: inputs = [cond_var]
+        if not inputs:
+            raise ValueError("ConditionalBlock needs the condition var")
+        self.cond = inputs[0]
+        self.helper = LayerHelper('conditional_block', name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.helper.main_program
+        prog.create_block()
+        sub_block = prog.current_block()
+        sub_idx = sub_block.idx
+        try:
+            yield
+        except Exception:
+            prog.rollback()  # leave the builder usable (WhileGuard parity)
+            raise
+        prog.rollback()
+        # declare the sub-block's written vars as op outputs: autodiff
+        # publishing, prune reachability, and fetch all key off
+        # output_arg_names (the op publishes values via __env_update__)
+        written = []
+        for op in sub_block.ops:
+            for n in op.output_arg_names:
+                try:
+                    written.append(sub_block.var_recursive(n))
+                except KeyError:
+                    pass
+        self.helper.append_op(
+            type='conditional_block',
+            inputs={'Cond': [self.cond]},
+            outputs={'Out': written},
+            attrs={'sub_block': sub_idx},
+            infer_shape=False)
 
 
 class ParallelDo(object):

@@ -100,6 +100,92 @@ def test_dynamic_rnn_masks_ragged_rows():
                                rtol=1e-6)
 
 
+def test_conditional_block_selects_writes():
+    """Vars written inside ConditionalBlock keep their value when cond is
+    true and roll back (zeros for block-born vars) when false."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        flag = fluid.layers.data(name='flag', shape=[1], dtype='float32')
+        zero = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                          value=0.0)
+        cond = fluid.layers.less_than(x=zero, y=flag)  # flag > 0
+        cb = fluid.layers.ConditionalBlock([cond])
+        with cb.block():
+            doubled = fluid.layers.scale(x=x, scale=2.0)
+        out = doubled
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[1.0, 3.0]], 'float32')
+    on, = exe.run(main, feed={'x': xv, 'flag': np.ones((1, 1), 'f4')},
+                  fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(on), xv * 2, rtol=1e-6)
+    off, = exe.run(main, feed={'x': xv,
+                               'flag': np.zeros((1, 1), 'f4')},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(off), np.zeros_like(xv),
+                               rtol=1e-6)
+
+
+def test_conditional_block_in_training_and_prune():
+    """Block-written vars are real op outputs: they survive autodiff
+    publishing, prune, and an exception inside block() leaves the
+    builder usable."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        flag = fluid.layers.data(name='flag', shape=[1], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        zero = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                          value=0.0)
+        cond = fluid.layers.less_than(x=zero, y=flag)
+        cb = fluid.layers.ConditionalBlock([cond])
+        with cb.block():
+            h = fluid.layers.fc(input=x, size=8, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+        # prune keeps the conditional_block (h is one of its outputs)
+        pruned = main.prune(targets=[h.name], feeds=['x', 'flag'])
+        assert any(op.type == 'conditional_block'
+                   for op in pruned.global_block().ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    w = r.randn(4, 1).astype('float32')
+    flag_on = np.ones((1, 1), 'float32')
+    ls, hs = [], None
+    for _ in range(30):
+        xb = r.randn(8, 4).astype('float32')
+        lv, hs = exe.run(main, feed={'x': xb, 'flag': flag_on,
+                                     'y': xb @ w},
+                         fetch_list=[loss, h])  # h fetchable w/ autodiff
+        ls.append(float(np.ravel(lv)[0]))
+    assert np.asarray(hs).shape == (8, 8)
+    assert ls[-1] < ls[0] * 0.5  # grads flow through the select
+
+    # exception inside block(): builder recovers to the outer block
+    main2 = fluid.Program()
+    with fluid.program_guard(main2, fluid.Program()):
+        a = fluid.layers.data(name='a', shape=[2], dtype='float32')
+        zero2 = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=0.0)
+        cb2 = fluid.layers.ConditionalBlock(
+            [fluid.layers.less_than(x=zero2, y=a)])
+        try:
+            with cb2.block():
+                raise RuntimeError('boom')
+        except RuntimeError:
+            pass
+        after = fluid.layers.scale(x=a, scale=3.0)
+        assert after.block.idx == 0  # back in the global block
+
+
 def test_ifelse_merges_rows():
     main = fluid.Program()
     startup = fluid.Program()
