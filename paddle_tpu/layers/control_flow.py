@@ -504,39 +504,45 @@ class ConditionalBlock(object):
     reaches XLA)."""
 
     def __init__(self, inputs, name=None):
-        # parity signature: inputs = [cond_var]
+        # parity signature: inputs = [cond_var]; the reference also allows
+        # extra block-input vars with elementwise (non-scalar) conditions,
+        # which this build does not implement — fail loudly, not silently
         if not inputs:
             raise ValueError("ConditionalBlock needs the condition var")
+        if len(inputs) > 1:
+            raise NotImplementedError(
+                "only the scalar-condition form ConditionalBlock([cond]) "
+                "is supported; use IfElse for per-row conditions")
         self.cond = inputs[0]
         self.helper = LayerHelper('conditional_block', name=name)
 
     @contextlib.contextmanager
     def block(self):
         prog = self.helper.main_program
-        prog.create_block()
-        sub_block = prog.current_block()
-        sub_idx = sub_block.idx
+        sub_block = prog.create_block()
         try:
             yield
         except Exception:
             prog.rollback()  # leave the builder usable (WhileGuard parity)
             raise
         prog.rollback()
-        # declare the sub-block's written vars as op outputs: autodiff
-        # publishing, prune reachability, and fetch all key off
+        # declare the sub-block's written vars (nested control-flow blocks
+        # included — same recursion the runtime uses) as op outputs:
+        # autodiff publishing, prune reachability, and fetch all key off
         # output_arg_names (the op publishes values via __env_update__)
+        from ..ops.control_flow import _block_rw
+        _, written_names = _block_rw(prog, sub_block.idx)
         written = []
-        for op in sub_block.ops:
-            for n in op.output_arg_names:
-                try:
-                    written.append(sub_block.var_recursive(n))
-                except KeyError:
-                    pass
+        for n in sorted(written_names):
+            try:
+                written.append(sub_block.var_recursive(n))
+            except KeyError:
+                pass
         self.helper.append_op(
             type='conditional_block',
             inputs={'Cond': [self.cond]},
             outputs={'Out': written},
-            attrs={'sub_block': sub_idx},
+            attrs={'sub_block': sub_block.idx},
             infer_shape=False)
 
 

@@ -111,6 +111,52 @@ def test_transpiler_runner_trains():
     assert losses[-1] < losses[0]
 
 
+def test_batchnorm_conv_model_matches_single_device_on_mesh():
+    """ResNet-8 (conv + batch_norm) dp-sharded over 8 devices == single
+    device: BN batch statistics must be computed over the FULL sharded
+    batch (GSPMD turns the jnp.mean into a cross-shard reduction)."""
+    need_devices(8)
+
+    def build(seed):
+        main = fluid.Program()
+        startup = fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            from paddle_tpu.models import resnet
+            img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            pred = resnet.resnet_cifar10(img, depth=8, num_classes=10)
+            loss = fluid.layers.mean(
+                x=fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+        return main, startup, loss
+
+    r = np.random.RandomState(9)
+    batches = [{'img': r.randn(16, 3, 32, 32).astype('float32'),
+                'label': r.randint(0, 10, (16, 1)).astype('int64')}
+               for _ in range(3)]
+
+    main, startup, loss = build(33)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    single = [float(np.ravel(exe.run(main, feed=f,
+                                     fetch_list=[loss])[0])[0])
+              for f in batches]
+
+    main2, startup2, loss2 = build(33)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    mesh = api.make_mesh((8,), ('dp',))
+    dp = DataParallel(exe2, mesh)
+    sharded = [float(np.ravel(dp.run(main2, feed=f,
+                                     fetch_list=[loss2])[0])[0])
+               for f in batches]
+    np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.parametrize('model', ['mnist_conv', 'word2vec'])
 def test_book_models_on_mesh(model):
     """Two book models take real dp-sharded steps on the 8-device mesh

@@ -1,0 +1,43 @@
+"""P8 — streaming evaluators (Accuracy; ChunkEvaluator is covered by the
+SRL book test).
+
+Reference parity: fluid.evaluator.Accuracy usage in the reference book
+tests (accuracy.reset(exe) per pass, accuracy.eval(exe) streaming).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_accuracy_evaluator_streams_across_batches():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        scores = fluid.layers.data(name='scores', shape=[4],
+                                   dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        accuracy = fluid.evaluator.Accuracy(input=scores, label=label)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # batch 1: 2/4 correct; batch 2: 4/4 correct -> streaming 6/8
+    s1 = np.eye(4, dtype='float32')
+    l1 = np.array([[0], [1], [0], [1]], dtype='int64')  # rows 0,1 correct
+    s2 = np.eye(4, dtype='float32')
+    l2 = np.array([[0], [1], [2], [3]], dtype='int64')  # all correct
+
+    accuracy.reset(exe)
+    b1, = exe.run(main, feed={'scores': s1, 'label': l1},
+                  fetch_list=accuracy.metrics)
+    assert abs(float(np.ravel(b1)[0]) - 0.5) < 1e-6
+    b2, = exe.run(main, feed={'scores': s2, 'label': l2},
+                  fetch_list=accuracy.metrics)
+    assert abs(float(np.ravel(b2)[0]) - 1.0) < 1e-6
+    streamed = float(accuracy.eval(exe)[0])
+    assert abs(streamed - 6.0 / 8.0) < 1e-6
+
+    # reset starts a new pass
+    accuracy.reset(exe)
+    exe.run(main, feed={'scores': s1, 'label': l1},
+            fetch_list=accuracy.metrics)
+    assert abs(float(accuracy.eval(exe)[0]) - 0.5) < 1e-6

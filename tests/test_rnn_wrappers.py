@@ -186,6 +186,43 @@ def test_conditional_block_in_training_and_prune():
         assert after.block.idx == 0  # back in the global block
 
 
+def test_conditional_block_nested_while_outputs_visible():
+    """Writes inside control flow NESTED in the conditional block are
+    declared as outputs too (prune keeps the op; fetch sees the value)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        flag = fluid.layers.data(name='flag', shape=[1], dtype='float32')
+        zero = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                          value=0.0)
+        cond = fluid.layers.less_than(x=zero, y=flag)
+        cb = fluid.layers.ConditionalBlock([cond])
+        with cb.block():
+            i = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=0.0)
+            limit = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                               value=3.0)
+            acc = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                             value=0.0)
+            wcond = fluid.layers.less_than(x=i, y=limit)
+            w = fluid.layers.While(cond=wcond, max_iters=3)
+            with w.block():
+                fluid.layers.increment(x=acc, value=1.0, in_place=True)
+                fluid.layers.increment(x=i, value=1.0, in_place=True)
+                fluid.layers.less_than(x=i, y=limit, cond=wcond)
+        cb_op = [op for op in main.global_block().ops
+                 if op.type == 'conditional_block'][0]
+        assert acc.name in cb_op.output_arg_names  # nested write surfaced
+        pruned = main.prune(targets=[acc.name], feeds=['flag'])
+        assert any(op.type == 'conditional_block'
+                   for op in pruned.global_block().ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, = exe.run(main, feed={'flag': np.ones((1, 1), 'float32')},
+                   fetch_list=[acc])
+    np.testing.assert_allclose(np.ravel(got), [3.0], rtol=1e-6)
+
+
 def test_ifelse_merges_rows():
     main = fluid.Program()
     startup = fluid.Program()
