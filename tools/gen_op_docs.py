@@ -20,18 +20,31 @@ def generate(out_path=None):
     import paddle_tpu  # noqa: F401  (registers the op library)
     from paddle_tpu.core.registry import _OP_REGISTRY
 
+    import importlib
+
     lines = ['# Operator reference', '',
              '%d registered ops.  Grad comes from functional autodiff '
-             '(core/backward.py), not per-op grad kernels.' %
-             len(_OP_REGISTRY), '',
+             '(core/backward.py), not per-op grad kernels.  Ops without '
+             'their own docstring show their module\'s reference-parity '
+             'line.' % len(_OP_REGISTRY), '',
              '| op | module | doc |', '|---|---|---|']
+    mod_docs = {}
     for name in sorted(_OP_REGISTRY):
         impl = _OP_REGISTRY[name]
         fn = getattr(impl, 'fn', None) or getattr(impl, 'compute', impl)
         doc = (inspect.getdoc(fn) or '').split('\n')[0].strip()
-        mod = getattr(fn, '__module__', '?').replace('paddle_tpu.', '')
+        mod = getattr(fn, '__module__', '?')
+        if not doc:  # fall back to the module's parity line
+            if mod not in mod_docs:
+                try:
+                    mdoc = inspect.getdoc(importlib.import_module(mod))
+                    mod_docs[mod] = (mdoc or '').split('\n')[0].strip()
+                except Exception:
+                    mod_docs[mod] = ''
+            doc = mod_docs[mod]
         lines.append('| `%s` | %s | %s |' %
-                     (name, mod, doc.replace('|', '\\|')))
+                     (name, mod.replace('paddle_tpu.', ''),
+                      doc.replace('|', '\\|')))
     text = '\n'.join(lines) + '\n'
     if out_path:
         with open(out_path, 'w') as f:
