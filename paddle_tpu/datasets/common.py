@@ -107,8 +107,9 @@ def cluster_files_reader(files_pattern, trainer_count, trainer_id,
 
 def convert(output_path, reader, line_count, name_prefix):
     """Dump a reader into length-prefixed record files (the TPU-native
-    recordio, paddle_tpu/io_recordio.py) for fast re-reads."""
-    from ..io_recordio import RecordWriter
+    recordio; C++ writer when built, io_recordio fallback).  Read back
+    with reader.creator.recordio."""
+    from ..runtime.native import NativeRecordWriter
     import pickle
     indx_f = 0
     lines = []
@@ -119,7 +120,7 @@ def convert(output_path, reader, line_count, name_prefix):
             return
         path = os.path.join(output_path,
                             "%s-%05d" % (name_prefix, indx_f))
-        with RecordWriter(path) as w:
+        with NativeRecordWriter(path) as w:
             for d in lines:
                 w.write(pickle.dumps(d))
         lines = []

@@ -11,8 +11,10 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,
                         firstn, xmap_readers, cache, PipeReader,
                         ComposeNotAligned)
 from .minibatch import batch
+from . import creator
 
 __all__ = [
     'map_readers', 'buffered', 'compose', 'chain', 'shuffle', 'firstn',
     'xmap_readers', 'cache', 'PipeReader', 'ComposeNotAligned', 'batch',
+    'creator',
 ]

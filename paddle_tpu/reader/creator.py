@@ -1,0 +1,54 @@
+"""Reader creators (V1).
+
+Reference parity: python/paddle/v2/reader/creator.py — np_array,
+text_file, recordio.  The recordio creator reads the record files
+`datasets.common.convert` writes (C++ reader when the native runtime is
+built, io_recordio fallback otherwise).
+"""
+import pickle
+
+__all__ = ['np_array', 'text_file', 'recordio']
+
+
+def np_array(x):
+    """Creator yielding rows of a numpy array (reference np_array)."""
+
+    def reader():
+        import numpy as np
+        for row in np.asarray(x):
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Creator yielding stripped lines of a text file."""
+
+    def reader():
+        with open(path, 'r') as f:
+            for line in f:
+                yield line.rstrip('\n')
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Creator yielding unpickled samples from record files written by
+    datasets.common.convert (reference creator.recordio over the cluster
+    recordio chunks).  `paths` is a path, a list, or a comma-joined
+    string of paths; `buf_size` samples are read ahead on a background
+    thread (reference parity)."""
+    if isinstance(paths, str):
+        paths = paths.split(',')
+    elif not isinstance(paths, (list, tuple)):
+        paths = [paths]
+
+    def reader():
+        from ..runtime.native import NativeRecordReader
+        for path in paths:
+            with NativeRecordReader(path) as r:
+                for blob in r:
+                    yield pickle.loads(blob)
+
+    from .decorator import buffered
+    return buffered(reader, buf_size) if buf_size else reader

@@ -241,7 +241,9 @@ class NativeRecordReader(object):
         return data
 
     def close(self):
-        if self._r is None and self._h:
+        if self._r is not None:
+            self._r.close()  # io_recordio fallback holds an open file
+        elif self._h:
             self._lib.rio_reader_close(self._h)
             self._h = None
 

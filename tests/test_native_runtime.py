@@ -174,6 +174,42 @@ def test_xmap_native_unordered_and_ordered():
     assert ordered == [3 * i for i in range(50)]
 
 
+def test_dataset_convert_recordio_roundtrip(tmp_path):
+    """datasets.common.convert -> reader.creator.recordio round trip
+    (V3 dataset cache over the N3 record format), multiple chunk files."""
+    from paddle_tpu.datasets import common
+    from paddle_tpu.reader import creator
+
+    samples = [(np.arange(4, dtype='float32') + i, i) for i in range(10)]
+
+    def source():
+        return iter(samples)
+
+    out = str(tmp_path)
+    common.convert(out, source, line_count=3, name_prefix='unit')
+    files = sorted(os.listdir(out))
+    assert len(files) == 4  # 10 samples / 3 per chunk
+    got = list(creator.recordio([os.path.join(out, f)
+                                 for f in files])())
+    assert len(got) == 10
+    for (arr, lab), (w_arr, w_lab) in zip(got, samples):
+        assert lab == w_lab
+        np.testing.assert_array_equal(arr, w_arr)
+
+
+def test_creator_np_array_and_text_file(tmp_path):
+    from paddle_tpu.reader import creator
+
+    arr = np.arange(6).reshape(3, 2)
+    rows = list(creator.np_array(arr)())
+    assert len(rows) == 3
+    np.testing.assert_array_equal(rows[1], [2, 3])
+
+    p = tmp_path / 'lines.txt'
+    p.write_text('alpha\nbeta\n')
+    assert list(creator.text_file(str(p))()) == ['alpha', 'beta']
+
+
 def test_feed_pipeline_streams_device_batches():
     from paddle_tpu.runtime import FeedPipeline
 
