@@ -219,8 +219,11 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation='sigmoid',
                 candidate_activation='tanh', h_0=None, dtype='float32',
-                **kwargs):
-    """Parity with fluid.layers.dynamic_gru: `input` is [B, T, 3H]."""
+                use_pallas=False, **kwargs):
+    """Parity with fluid.layers.dynamic_gru: `input` is [B, T, 3H].
+
+    use_pallas=True requests the fused VMEM-carry time-loop kernel on the
+    TPU backend (full-length forward default-activation configs)."""
     helper = LayerHelper('gru', **kwargs)
     hidden = size
     from ..param_attr import ParamAttr
@@ -238,6 +241,7 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
     helper.append_op(
         type='gru', inputs=inputs, outputs={'Hidden': [hidden_out]},
         attrs={'is_reverse': is_reverse,
+               'use_pallas': use_pallas,
                'gate_activation': gate_activation,
                'activation': candidate_activation})
     _copy_len(helper, input, hidden_out)

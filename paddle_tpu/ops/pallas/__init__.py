@@ -4,6 +4,6 @@ Tests run them with interpret=True on CPU; on a TPU backend the same
 kernels compile to Mosaic.
 """
 from .flash_attention import flash_attention  # noqa: F401
-from .lstm_cell import lstm_scan  # noqa: F401
+from .lstm_cell import gru_scan, lstm_scan  # noqa: F401
 
-__all__ = ['flash_attention', 'lstm_scan']
+__all__ = ['flash_attention', 'lstm_scan', 'gru_scan']

@@ -1,4 +1,4 @@
-"""Fused LSTM time-loop as a Pallas TPU kernel.
+"""Fused LSTM and GRU time-loops as Pallas TPU kernels.
 
 Reference parity: paddle/operators/lstm_op.cc runs per-timestep GEMMs +
 separate elementwise gate kernels.  XLA's lax.scan version (ops/rnn.py)
@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ['lstm_scan']
+__all__ = ['lstm_scan', 'gru_scan']
 
 
 def _lstm_kernel(x_ref, w_ref, o_h_ref, o_c_ref, h_scr, c_scr, *, hidden):
@@ -120,3 +120,85 @@ def _bwd(res, cts):
 
 
 lstm_scan.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------- GRU
+def _gru_kernel(x_ref, w_ref, o_ref, h_scr, *, hidden):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = jnp.zeros_like(h_scr[...])
+
+    g = x_ref[0].astype(jnp.float32)  # [B, 3H] pre-projected gates
+    w = w_ref[...].astype(jnp.float32)  # [H, 3H]
+    h_p = h_scr[...]
+    rz = g[:, :2 * hidden] + jax.lax.dot_general(
+        h_p, w[:, :2 * hidden], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    u = jax.nn.sigmoid(rz[:, :hidden])      # update gate
+    r = jax.nn.sigmoid(rz[:, hidden:])      # reset gate
+    c = jnp.tanh(g[:, 2 * hidden:] + jax.lax.dot_general(
+        r * h_p, w[:, 2 * hidden:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32))
+    h = u * h_p + (1.0 - u) * c
+    h_scr[...] = h
+    o_ref[0] = h.astype(o_ref.dtype)
+
+
+def _gru_scan_reference(x_tm, w):
+    """Identical recurrence as lax.scan (ops/rnn.py gru math)."""
+    hdim = w.shape[0]
+    w_rz = w[:, :2 * hdim].astype(jnp.float32)
+    w_c = w[:, 2 * hdim:].astype(jnp.float32)
+
+    def step(h_p, g_t):
+        g = g_t.astype(jnp.float32)
+        rz = g[:, :2 * hdim] + jnp.matmul(
+            h_p, w_rz, preferred_element_type=jnp.float32)
+        u = jax.nn.sigmoid(rz[:, :hdim])
+        r = jax.nn.sigmoid(rz[:, hdim:])
+        c = jnp.tanh(g[:, 2 * hdim:] + jnp.matmul(
+            r * h_p, w_c, preferred_element_type=jnp.float32))
+        h = u * h_p + (1.0 - u) * c
+        return h, h
+
+    b = x_tm.shape[1]
+    _, hs = jax.lax.scan(step, jnp.zeros((b, hdim), jnp.float32), x_tm)
+    return hs.astype(x_tm.dtype)
+
+
+@jax.custom_vjp
+def gru_scan(x_tm, w):
+    """Fused GRU over time-major gates x_tm [T, B, 3H], recurrent weight
+    w [H, 3H] ([:, :2H] update/reset, [:, 2H:] candidate); zero initial
+    state.  Returns hs [T, B, H]."""
+    t, b, three_h = x_tm.shape
+    hidden = three_h // 3
+    interpret = jax.default_backend() != 'tpu'
+    kernel = functools.partial(_gru_kernel, hidden=hidden)
+    return pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, three_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
+        scratch_shapes=[pltpu.VMEM((b, hidden), jnp.float32)],
+        interpret=interpret,
+    )(x_tm, w)
+
+
+def _gru_fwd(x_tm, w):
+    return gru_scan(x_tm, w), (x_tm, w)
+
+
+def _gru_bwd(res, ct):
+    x_tm, w = res
+    _, vjp = jax.vjp(_gru_scan_reference, x_tm, w)
+    return vjp(ct)
+
+
+gru_scan.defvjp(_gru_fwd, _gru_bwd)

@@ -151,6 +151,22 @@ def _gru(ctx, ins, attrs):
     h0 = first(ins, 'H0')
     b, t, threeh = x.shape
     h = threeh // 3
+
+    xf = x.astype(jnp.float32)
+    if bias is not None:
+        xf = xf + bias.astype(jnp.float32).reshape(1, 1, -1)
+
+    if attrs.get('use_pallas') and lengths is None and h0 is None and \
+            not attrs.get('is_reverse', False) and \
+            attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
+            attrs.get('activation', 'tanh') == 'tanh' and \
+            (jax.default_backend() == 'tpu' or
+             attrs.get('pallas_interpret', False)):
+        # fused Pallas time loop (ops/pallas/lstm_cell.gru_scan)
+        from .pallas.lstm_cell import gru_scan
+        hs = gru_scan(jnp.swapaxes(xf, 0, 1), w)
+        return {'Hidden': [jnp.swapaxes(hs, 0, 1).astype(x.dtype)]}
+
     if lengths is None:
         lengths = jnp.full((b,), t, jnp.int32)
     lengths = lengths.astype(jnp.int32).reshape(-1)
@@ -159,10 +175,6 @@ def _gru(ctx, ins, attrs):
     is_reverse = attrs.get('is_reverse', False)
     w_rz = w[:, :2 * h]
     w_c = w[:, 2 * h:]
-
-    xf = x.astype(jnp.float32)
-    if bias is not None:
-        xf = xf + bias.astype(jnp.float32).reshape(1, 1, -1)
     if is_reverse:
         idx = jnp.arange(t)
         rev_idx = jnp.where(idx[None, :] < lengths[:, None],

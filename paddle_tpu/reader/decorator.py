@@ -91,19 +91,22 @@ def compose(*readers, **kwargs):
 
 
 def buffered(reader, size):
-    """Pre-read up to ``size`` samples into a queue on a worker thread."""
+    """Pre-read up to ``size`` samples into a queue on a worker thread.
+    A source error (e.g. a recordio CRC mismatch) re-raises in the
+    consumer instead of silently truncating the stream."""
 
     class EndSignal(object):
-        pass
-
-    end = EndSignal()
+        def __init__(self, error=None):
+            self.error = error
 
     def read_worker(r, q):
         try:
             for d in r:
                 q.put(d)
-        finally:
-            q.put(end)
+        except BaseException as e:
+            q.put(EndSignal(e))
+        else:
+            q.put(EndSignal())
 
     def data_reader():
         r = reader()
@@ -112,9 +115,11 @@ def buffered(reader, size):
         t.daemon = True
         t.start()
         e = q.get()
-        while e is not end:
+        while not isinstance(e, EndSignal):
             yield e
             e = q.get()
+        if e.error is not None:
+            raise e.error
 
     return data_reader
 

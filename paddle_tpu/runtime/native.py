@@ -242,7 +242,11 @@ class NativeRecordReader(object):
 
     def close(self):
         if self._r is not None:
-            self._r.close()  # io_recordio fallback holds an open file
+            try:
+                self._r.close()  # fallback holds an open file
+            finally:
+                self._r = iter(())  # post-close: StopIteration, not a
+                # read-of-closed-file ValueError (native-path contract)
         elif self._h:
             self._lib.rio_reader_close(self._h)
             self._h = None

@@ -197,6 +197,34 @@ def test_dataset_convert_recordio_roundtrip(tmp_path):
         np.testing.assert_array_equal(arr, w_arr)
 
 
+def test_buffered_creator_surfaces_corruption(tmp_path):
+    """A CRC error mid-stream re-raises through the buffered readahead
+    instead of silently truncating the dataset."""
+    import pickle
+    path = str(tmp_path / 'corrupt.rio')
+    with NativeRecordWriter(path) as w:
+        for i in range(5):
+            w.write(pickle.dumps(i))
+    with open(path, 'r+b') as f:
+        f.seek(-2, os.SEEK_END)
+        f.write(b'XX')
+    from paddle_tpu.reader import creator
+    with pytest.raises((IOError, OSError)):
+        list(creator.recordio(path)())  # default buffered path
+
+
+def test_record_reader_close_then_next_stops(tmp_path):
+    path = str(tmp_path / 'c.rio')
+    with NativeRecordWriter(path) as w:
+        w.write(b'one')
+        w.write(b'two')
+    r = NativeRecordReader(path)
+    assert next(r) == b'one'
+    r.close()
+    with pytest.raises(StopIteration):
+        next(r)
+
+
 def test_creator_np_array_and_text_file(tmp_path):
     from paddle_tpu.reader import creator
 

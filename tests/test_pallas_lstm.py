@@ -50,6 +50,47 @@ def test_lstm_scan_grads_match_scan():
                                    rtol=1e-4, atol=1e-5, err_msg=name)
 
 
+def test_gru_scan_matches_scan_op():
+    B, T, H = 8, 10, 16
+    x = rng.randn(B, T, 3 * H).astype('float32')
+    w = (rng.randn(H, 3 * H) * 0.5).astype('float32')
+    want = run_op('gru', {'Input': x, 'Weight': w})
+    from paddle_tpu.ops.pallas import gru_scan
+    hs = gru_scan(jnp.swapaxes(jnp.asarray(x), 0, 1), jnp.asarray(w))
+    np.testing.assert_allclose(np.swapaxes(np.asarray(hs), 0, 1),
+                               np.asarray(want['Hidden'][0]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_scan_grads_match_scan():
+    B, T, H = 4, 5, 8
+    x = jnp.asarray(rng.randn(T, B, 3 * H), jnp.float32)
+    w = jnp.asarray(rng.randn(H, 3 * H) * 0.5, jnp.float32)
+    from paddle_tpu.ops.pallas import gru_scan
+    from paddle_tpu.ops.pallas.lstm_cell import _gru_scan_reference
+
+    gp = jax.grad(lambda x, w: jnp.sum(jnp.sin(gru_scan(x, w))),
+                  argnums=(0, 1))(x, w)
+    gs = jax.grad(lambda x, w: jnp.sum(jnp.sin(_gru_scan_reference(x, w))),
+                  argnums=(0, 1))(x, w)
+    for a, b, name in zip(gp, gs, ('dx', 'dw')):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_gru_op_use_pallas_attr():
+    B, T, H = 4, 5, 8
+    x = rng.randn(B, T, 3 * H).astype('float32')
+    w = (rng.randn(H, 3 * H) * 0.5).astype('float32')
+    bias = (rng.randn(1, 3 * H) * 0.1).astype('float32')
+    base = run_op('gru', {'Input': x, 'Weight': w, 'Bias': bias})
+    fused = run_op('gru', {'Input': x, 'Weight': w, 'Bias': bias},
+                   {'use_pallas': True, 'pallas_interpret': True})
+    np.testing.assert_allclose(np.asarray(fused['Hidden'][0]),
+                               np.asarray(base['Hidden'][0]),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_lstm_op_use_pallas_attr():
     """The lstm op's use_pallas fast path == the scan path, and ragged
     inputs fall back (different code path, same contract)."""
