@@ -29,6 +29,7 @@ def main():
         batch, hw, depth, classes, steps, warmup = 64, 224, 50, 1000, 20, 3
     else:
         batch, hw, depth, classes, steps, warmup = 8, 64, 18, 100, 3, 1
+    batch = int(os.environ.get('PADDLE_TPU_BENCH_BATCH', batch))
 
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
@@ -37,13 +38,14 @@ def main():
     # MXU recipe (SURVEY §6.4); PADDLE_TPU_BENCH_DTYPE/LAYOUT override.
     dtype = os.environ.get('PADDLE_TPU_BENCH_DTYPE', 'bfloat16')
     layout = os.environ.get('PADDLE_TPU_BENCH_LAYOUT', 'NHWC')
+    stem = os.environ.get('PADDLE_TPU_BENCH_STEM', '7x7')
     image_shape = (hw, hw, 3) if layout == 'NHWC' else (3, hw, hw)
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         img, label, prediction, avg_cost, acc = resnet.build_imagenet(
             depth=depth, num_classes=classes, image_shape=image_shape,
-            dtype=dtype, layout=layout)
+            dtype=dtype, layout=layout, stem=stem)
         opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
                                                 momentum=0.9)
         opt.minimize(avg_cost)

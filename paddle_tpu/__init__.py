@@ -49,6 +49,7 @@ from . import parallel
 from . import distributed
 from .distributed import DistributeTranspiler, SimpleDistributeTranspiler
 from . import highlevel  # v2 trainer/event/parameters/inference (V5-V7)
+from . import plot  # v2 notebook training-curve Ploter
 from . import flags  # A5 env-var config registry
 from .flags import FLAGS
 from . import debug  # A3 nan/inf guards

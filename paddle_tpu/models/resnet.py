@@ -79,11 +79,46 @@ _DEPTH_CFG = {
 }
 
 
-def resnet_imagenet(input, depth=50, num_classes=1000, layout='NCHW'):
-    """Reference: benchmark/paddle/image/resnet.py (ImageNet layout)."""
+def _space_to_depth_stem(input, layout):
+    """TPU stem: rearrange 2x2 pixel blocks into channels, then a 4x4
+    stride-1 conv in block space.
+
+    The reference's 7x7/2 stem conv (benchmark/paddle/image/resnet.py)
+    puts a 3-channel input on the MXU, wasting most of the 128-lane
+    contraction dimension.  Re-basing to 2x2 blocks ([B,224,224,3] ->
+    [B,112,112,12]) makes the contraction 4x denser at identical math:
+    a zero-padded 8x8/2 conv over pixels IS a 4x4/1 conv over blocks
+    (window [2o-4, 2o+3] = blocks o-2..o+1 -> block pad (2,1), VALID).
+    Trained from scratch the 8x8 basis is a strict superset of the 7x7.
+    """
+    if layout == 'NHWC':
+        b, h, w, c = input.shape
+        x = fluid.layers.reshape(input, [b, h // 2, 2, w // 2, 2, c])
+        x = fluid.layers.transpose(x, [0, 1, 3, 2, 4, 5])
+        x = fluid.layers.reshape(x, [b, h // 2, w // 2, 4 * c])
+        x = fluid.layers.pad(x, [0, 0, 2, 1, 2, 1, 0, 0])
+    else:
+        b, c, h, w = input.shape
+        x = fluid.layers.reshape(input, [b, c, h // 2, 2, w // 2, 2])
+        x = fluid.layers.transpose(x, [0, 1, 3, 5, 2, 4])
+        x = fluid.layers.reshape(x, [b, 4 * c, h // 2, w // 2])
+        x = fluid.layers.pad(x, [0, 0, 0, 0, 2, 1, 2, 1])
+    return conv_bn_layer(x, ch_out=64, filter_size=4, stride=1, padding=0,
+                         layout=layout)
+
+
+def resnet_imagenet(input, depth=50, num_classes=1000, layout='NCHW',
+                    stem='7x7'):
+    """Reference: benchmark/paddle/image/resnet.py (ImageNet layout).
+
+    stem='space_to_depth' swaps the 7x7/2 first conv for the MXU-dense
+    block-space equivalent (see _space_to_depth_stem)."""
     block, counts = _DEPTH_CFG[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
-                          padding=3, layout=layout)
+    if stem == 'space_to_depth':
+        conv1 = _space_to_depth_stem(input, layout)
+    else:
+        conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                              padding=3, layout=layout)
     pool1 = fluid.layers.pool2d(
         input=conv1, pool_size=3, pool_stride=2, pool_padding=1,
         pool_type='max', data_format=layout)
@@ -102,7 +137,7 @@ def resnet_imagenet(input, depth=50, num_classes=1000, layout='NCHW'):
 
 
 def build_imagenet(depth=50, num_classes=1000, image_shape=(3, 224, 224),
-                   dtype='float32', layout='NCHW'):
+                   dtype='float32', layout='NCHW', stem='7x7'):
     """Returns (img, label, prediction, avg_cost, acc) — the bench model.
 
     dtype='bfloat16' runs conv/matmul activations in bf16 with fp32
@@ -117,7 +152,7 @@ def build_imagenet(depth=50, num_classes=1000, image_shape=(3, 224, 224),
     if dtype == 'bfloat16':
         x = fluid.layers.cast(x=x, dtype='bfloat16')
     prediction = resnet_imagenet(x, depth=depth, num_classes=num_classes,
-                                 layout=layout)
+                                 layout=layout, stem=stem)
     cost = fluid.layers.cross_entropy(input=prediction, label=label)
     avg_cost = fluid.layers.mean(x=cost)
     acc = fluid.layers.accuracy(input=prediction, label=label)

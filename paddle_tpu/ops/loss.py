@@ -55,6 +55,7 @@ def _sigmoid_ce(ctx, ins, attrs):
     return out(loss)
 
 
+@register_op('smooth_l1')  # the reference op name (smooth_l1_op.cc)
 @register_op('smooth_l1_loss')
 def _smooth_l1(ctx, ins, attrs):
     x = first(ins, 'X').astype(jnp.float32)

@@ -17,7 +17,7 @@ __all__ = ['pipeline_apply']
 
 
 def pipeline_apply(stage_fn, params_shard, microbatches, axis_name,
-                   num_stages=None):
+                   num_stages=None, remat=False):
     """Run a GPipe pipeline inside shard_map.
 
     stage_fn(params, x) -> y: one stage's compute (same code every stage;
@@ -31,6 +31,11 @@ def pipeline_apply(stage_fn, params_shard, microbatches, axis_name,
     gather as needed).
     """
     S = num_stages if num_stages is not None else lax.psum(1, axis_name)
+    if remat:
+        # 1F1B's memory win, compiler-style: store only stage inputs and
+        # recompute the stage body in the backward pipeline wave instead
+        # of keeping S+M-1 ticks of activations live.
+        stage_fn = jax.checkpoint(stage_fn)
     rank = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]

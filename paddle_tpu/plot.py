@@ -1,0 +1,90 @@
+"""Training-curve plotting for notebooks.
+
+Reference parity: python/paddle/v2/plot/plot.py (`Ploter`) — collects
+(step, value) series per metric title and redraws them on one figure,
+falling back to a text log when matplotlib/IPython are unavailable or
+``DISABLE_PLOT=True`` (the reference's headless-CI escape hatch).
+"""
+import os
+
+__all__ = ['Ploter', 'PlotData']
+
+
+class PlotData(object):
+    """One named series: parallel step/value lists."""
+
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(float(value))
+
+    def reset(self):
+        del self.step[:]
+        del self.value[:]
+
+
+def _plotting_disabled():
+    return os.environ.get('DISABLE_PLOT', '').lower() == 'true'
+
+
+class Ploter(object):
+    """Ploter('train cost', 'test cost'); .append(title, step, value);
+    .plot() redraws all series (or prints them headless)."""
+
+    def __init__(self, *titles):
+        self._titles = titles
+        self._data = {t: PlotData() for t in titles}
+        self._disabled = _plotting_disabled()
+        self._plt = None
+        self._display = None
+        self._fig = None
+        if not self._disabled:
+            try:
+                import matplotlib.pyplot as plt
+                self._plt = plt
+            except Exception:
+                self._disabled = True
+            try:
+                from IPython import display
+                self._display = display
+            except Exception:
+                self._display = None
+
+    def __getitem__(self, title):
+        return self._data[title]
+
+    def append(self, title, step, value):
+        assert title in self._data, (
+            'no series %r (have %r)' % (title, list(self._titles)))
+        self._data[title].append(step, value)
+
+    def plot(self, path=None):
+        if self._disabled:
+            for t in self._titles:
+                d = self._data[t]
+                if d.step:
+                    print('%s step %d: %g' % (t, d.step[-1], d.value[-1]))
+            return
+        plt = self._plt
+        if self._fig is not None:
+            plt.close(self._fig)
+        self._fig = plt.figure()
+        for t in self._titles:
+            d = self._data[t]
+            plt.plot(d.step, d.value, label=t)
+        if any(self._data[t].step for t in self._titles):
+            plt.legend()
+        if path is not None:
+            plt.savefig(path)
+        elif self._display is not None:
+            self._display.clear_output(wait=True)
+            self._display.display(plt.gcf())
+        else:
+            plt.draw()
+
+    def reset(self):
+        for d in self._data.values():
+            d.reset()

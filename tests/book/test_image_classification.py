@@ -20,6 +20,9 @@ def test_image_classification(net):
     cost = fluid.layers.cross_entropy(input=predict, label=label)
     avg_cost = fluid.layers.mean(x=cost)
     acc = fluid.layers.accuracy(input=predict, label=label)
+    # deterministic eval program (dropout off, BN running stats) BEFORE
+    # the optimizer ops are appended
+    test_prog = fluid.default_main_program().clone(for_test=True)
 
     # reference test_image_classification_train.py: Adam lr=0.001
     opt = fluid.optimizer.AdamOptimizer(learning_rate=0.001)
@@ -33,9 +36,18 @@ def test_image_classification(net):
     reader = fluid.batch(
         fluid.reader.firstn(datasets.cifar.train10(), 256),
         batch_size=32, drop_last=True)
+    batches = list(reader())
+
+    def eval_cost():
+        cs = [float(np.ravel(exe.run(test_prog, feed=feeder.feed(b),
+                                     fetch_list=[avg_cost])[0])[0])
+              for b in batches]
+        return float(np.mean(cs))
+
+    pre = eval_cost() if net == 'vgg' else None
     costs, accs = [], []
     for epoch in range(3):
-        for batch in reader():
+        for batch in batches:
             c, a = exe.run(feed=feeder.feed(batch),
                            fetch_list=[avg_cost, acc])
             costs.append(float(np.ravel(c)[0]))
@@ -45,9 +57,11 @@ def test_image_classification(net):
         # small enough to converge within the CI budget
         assert np.mean(costs[-4:]) < np.mean(costs[:4])
     else:
-        # VGG16's 15 stacked dropouts make the per-batch cost noise (~0.1)
-        # larger than any 24-step convergence signal, and the reference
-        # book test asserts nothing at all for VGG.  Assert the cost does
-        # NOT trend upward: the inverted-dropout bug this guards against
-        # drove it up by +0.75 over these steps (2.90 -> 3.65).
-        assert np.mean(costs[-8:]) < np.mean(costs[:8]) + 0.25
+        # VGG16 is so dropout-heavy (15 stacked dropouts) that per-batch
+        # TRAIN cost is noise-dominated over a 24-step CI budget, so the
+        # convergence check runs on the DETERMINISTIC test-mode clone
+        # (dropout off, BN running stats): training must strictly lower
+        # the eval cost.  The inverted-dropout bug this guards against
+        # drove eval cost up by ~0.75 over the same steps.
+        post = eval_cost()
+        assert post < pre, (pre, post)

@@ -59,6 +59,73 @@ def test_grads_smooth():
         t.check_grad(['X'])
 
 
+# attr-carrying activations (ref activation_op.cc AttrChecker defaults)
+ATTR_CASES = {
+    'tanh_shrink': ({}, lambda x: x - np.tanh(x)),
+    'softshrink': ({'lambda': 0.4},
+                   lambda x: np.where(x > 0.4, x - 0.4,
+                                      np.where(x < -0.4, x + 0.4, 0.0))),
+    'hard_shrink': ({'threshold': 0.3},
+                    lambda x: np.where(np.abs(x) > 0.3, x, 0.0)),
+    'brelu': ({'t_min': -0.2, 't_max': 0.6},
+              lambda x: np.clip(x, -0.2, 0.6)),
+    'leaky_relu': ({'alpha': 0.1},
+                   lambda x: np.where(x >= 0, x, 0.1 * x)),
+    'soft_relu': ({'threshold': 40.0},
+                  lambda x: np.log1p(np.exp(np.clip(x, -40.0, 40.0)))),
+    'elu': ({'alpha': 0.5},
+            lambda x: np.where(x >= 0, x, 0.5 * (np.exp(x) - 1))),
+    'relu6': ({'threshold': 6.0}, lambda x: np.clip(x, 0.0, 6.0)),
+    'pow': ({'factor': 3.0}, lambda x: np.power(x, 3.0)),
+    'stanh': ({'scale_a': 0.67, 'scale_b': 1.7159},
+              lambda x: 1.7159 * np.tanh(0.67 * x)),
+    'thresholded_relu': ({'threshold': 0.25},
+                         lambda x: np.where(x > 0.25, x, 0.0)),
+    'hard_sigmoid': ({'slope': 0.2, 'offset': 0.5},
+                     lambda x: np.clip(0.2 * x + 0.5, 0.0, 1.0)),
+    'swish': ({'beta': 2.0}, lambda x: x / (1.0 + np.exp(-2.0 * x)) * 1.0),
+}
+
+
+def test_attr_activations_forward():
+    rng = np.random.default_rng(7)
+    for op, (attrs, ref) in ATTR_CASES.items():
+        x = rng.uniform(-1, 1, (4, 7)).astype('float32')
+        if op == 'pow':
+            x = np.abs(x) + 0.5
+
+        class _T(OpTest):
+            op_type = op
+
+            def setup(self):
+                self.inputs = {'X': x}
+                self.attrs = attrs
+                self.outputs = {'Out': ref(x)}
+
+        t = _T()
+        t.setup()
+        t.check_output(atol=1e-4, rtol=1e-3)
+
+
+def test_attr_activations_grads():
+    rng = np.random.default_rng(11)
+    for op in ['elu', 'swish', 'stanh', 'soft_relu']:
+        attrs, ref = ATTR_CASES[op]
+
+        class _T(OpTest):
+            op_type = op
+
+            def setup(self):
+                self.inputs = {'X': rng.uniform(
+                    0.2, 1.0, (3, 5)).astype('float32')}
+                self.attrs = attrs
+                self.outputs = {'Out': None}
+
+        t = _T()
+        t.setup()
+        t.check_grad(['X'])
+
+
 def test_parametric():
     x = np.random.uniform(-2, 2, (3, 5)).astype('float32')
     cases = [

@@ -151,3 +151,28 @@ def test_profiler_cost_analysis():
     assert isinstance(costs, dict)
     # a [4,16]x[16,32] + [4,32]x[32,4] model: flops must be visible
     assert costs.get('flops', 0) > 1000
+
+
+def test_ploter_headless(tmp_path, monkeypatch):
+    # v2 plot parity: series accumulate and render headless (DISABLE_PLOT)
+    monkeypatch.setenv('DISABLE_PLOT', 'True')
+    from paddle_tpu.plot import Ploter
+    p = Ploter('train cost', 'test cost')
+    for i in range(3):
+        p.append('train cost', i, 2.0 - 0.1 * i)
+    p.append('test cost', 0, 1.5)
+    assert p['train cost'].step == [0, 1, 2]
+    p.plot()  # text fallback, no matplotlib needed
+    p.reset()
+    assert p['train cost'].value == []
+
+
+def test_ploter_savefig(tmp_path, monkeypatch):
+    monkeypatch.delenv('DISABLE_PLOT', raising=False)
+    from paddle_tpu.plot import Ploter
+    p = Ploter('cost')
+    p.append('cost', 0, 1.0)
+    p.append('cost', 1, 0.5)
+    out = tmp_path / 'curve.png'
+    p.plot(str(out))
+    assert out.exists() and out.stat().st_size > 0

@@ -51,3 +51,27 @@ def test_m4_model_trains(name):
               for _ in range(2)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[1] < losses[0]  # one SGD step on a fixed batch reduces loss
+
+
+def test_resnet_space_to_depth_stem_trains():
+    # TPU stem variant (models/resnet.py:_space_to_depth_stem): must build
+    # and take a finite train step in both layouts at tiny shapes
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    for layout, shape in [('NHWC', (32, 32, 3)), ('NCHW', (3, 32, 32))]:
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            img, label, pred, cost, acc = resnet.build_imagenet(
+                depth=18, num_classes=10, image_shape=shape, layout=layout,
+                stem='space_to_depth')
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2,) + shape).astype(np.float32)
+        y = rng.integers(0, 10, (2, 1)).astype(np.int32)
+        c, = exe.run(main_prog, feed={'img': x, 'label': y},
+                     fetch_list=[cost])
+        assert np.isfinite(np.ravel(c)[0])

@@ -52,7 +52,7 @@ def test_elementwise_all():
     for name, fn in [('add', np.add), ('sub', np.subtract),
                      ('mul', np.multiply), ('div', np.divide),
                      ('max', np.maximum), ('min', np.minimum),
-                     ('pow', np.power)]:
+                     ('pow', np.power), ('mod', np.mod)]:
         o = run_op('elementwise_' + name, {'X': x, 'Y': y})['Out'][0]
         np.testing.assert_allclose(np.asarray(o), fn(x, y), rtol=1e-4)
 
