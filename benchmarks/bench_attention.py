@@ -1,0 +1,75 @@
+"""Long-context attention benchmark: Pallas flash-attention kernel
+(ops/pallas/flash_attention.py) at long sequence lengths on one chip.
+
+The reference's attention (fluid nets.scaled_dot_product_attention over
+matmul/softmax ops) materializes the [T, T] score matrix — at T=8192 that
+is 2 GB/head-batch in fp32 and does three HBM passes; the flash kernel
+keeps the online-softmax state in VMEM (one pass).  Multi-chip sequence
+parallelism over this kernel is parallel/ring_attention.py (tested on the
+virtual mesh; see test_parallel.py).
+
+Prints ONE JSON line: causal attention fwd+bwd tokens/s at the longest
+sequence that fits, plus achieved TFLOPS.
+"""
+import json
+import time
+
+import numpy as np
+
+import common  # noqa: F401  (sys.path bootstrap)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention
+
+    tpu = common.on_tpu()
+    if tpu:
+        B, T, H, D = 2, 8192, 8, 64
+        steps, warmup = 10, 2
+    else:
+        B, T, H, D = 1, 512, 2, 32
+        steps, warmup = 2, 1
+
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if tpu else jnp.float32
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    # chain q <- q - eps*dq so each step depends on the previous one:
+    # the device serializes the chain and ONE final sync times all steps
+    # (a per-step host sync would measure the tunnel RTT instead)
+    @jax.jit
+    def step(q, k, v):
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return (q - 1e-3 * dq).astype(q.dtype)
+
+    qq = step(q, k, v)
+    np.asarray(qq[0, 0, 0])  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        qq = step(qq, k, v)
+    np.asarray(qq[0, 0, 0])  # sync the whole chain
+    dt_s = (time.perf_counter() - t0) / steps
+
+    tokens_s = B * T / dt_s
+    # causal fwd 2*B*H*T^2*D MACs * 0.5, bwd ~2.5x fwd (flash recompute)
+    flops = 4 * B * H * T * T * D * 0.5 * 3.5
+    print(json.dumps({
+        "metric": "flash_attention_causal_train_tokens_per_sec",
+        "value": round(tokens_s, 2),
+        "achieved_tflops": round(flops / dt_s / 1e12, 2),
+        "note": "B=%d T=%d H=%d D=%d fwd+bwd %s" % (
+            B, T, H, D, 'bf16' if tpu else 'cpu-smoke'),
+    }))
+
+
+if __name__ == '__main__':
+    main()

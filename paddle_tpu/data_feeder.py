@@ -31,10 +31,14 @@ class DataToLoDTensorConverter(object):
     def done(self):
         if self.lod_level == 0:
             arr = np.array(self.data, dtype=self.dtype)
-            shape = [d for d in self.shape if d != -1]
-            if arr.ndim == 2 and len(shape) >= 1 and \
-                    arr.shape[1] == int(np.prod(shape)):
-                arr = arr.reshape([arr.shape[0]] + [int(s) for s in shape])
+            # honor the declared per-row rank: scalar label rows must land
+            # as [batch, 1] (the fluid LoDTensor contract) — a bare [batch]
+            # silently broadcasts against [batch, 1] vars downstream
+            shape = [int(d) for d in self.shape if d != -1]
+            if shape and list(arr.shape[1:]) != shape and \
+                    int(np.prod(arr.shape[1:], dtype=np.int64)) == \
+                    int(np.prod(shape)):
+                arr = arr.reshape([arr.shape[0]] + shape)
             return arr
         # one LoD level: each row is a sequence
         seqs = [np.asarray(s, dtype=self.dtype) for s in self.data]

@@ -2,9 +2,10 @@
 from .. import ops as _ops  # ensure op registry is populated  # noqa: F401
 
 from . import beam_search as _beam_search_mod
-from . import control_flow, io, nn, ops, sequence, tensor
+from . import control_flow, device, io, nn, ops, sequence, tensor
 from .beam_search import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .device import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

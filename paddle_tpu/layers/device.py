@@ -1,0 +1,22 @@
+"""Device-placement layers.
+
+Reference parity: python/paddle/v2/fluid/layers/device.py — `get_places`
+materializes the device list a ParallelDo would split over.  On TPU the
+device set is the jax mesh, so the op returns an int32 vector of logical
+device ordinals (ops/misc.py: get_places).
+"""
+from .layer_helper import LayerHelper
+
+__all__ = ['get_places']
+
+
+def get_places(device_count=None, device_type=None, **kwargs):
+    if device_count is None:
+        import jax
+        device_count = len(jax.devices())
+    helper = LayerHelper('get_places', **kwargs)
+    out = helper.create_tmp_variable('int32')
+    helper.append_op(type='get_places', outputs={'Out': [out]},
+                     attrs={'device_count': int(device_count),
+                            'device_type': device_type or 'TPU'})
+    return out

@@ -16,7 +16,8 @@ __all__ = [
     'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min', 'reduce_prod',
     'split', 'matmul', 'topk', 'l2_normalize', 'one_hot', 'cos_sim', 'lrn',
     'warpctc', 'nce', 'bilinear_tensor_product', 'prelu', 'pad',
-    'im2sequence', 'multiplex', 'row_conv', 'auc',
+    'im2sequence', 'multiplex', 'row_conv', 'auc', 'roi_pool',
+    'detection_output',
 ]
 
 
@@ -650,3 +651,42 @@ def row_conv(input, future_context_size, param_attr=None, act=None,
         inputs={'X': [input], 'Filter': [w]},
         outputs={'Out': [out]})
     return helper.append_activation(out)
+
+
+def roi_pool(input, rois, pooled_height, pooled_width, spatial_scale=1.0,
+             **kwargs):
+    """RoI max pooling (ref operators/roi_pool_op.cc): input [N, C, H, W],
+    rois [R, 5] rows (batch_idx, x1, y1, x2, y2) -> [R, C, ph, pw]."""
+    helper = LayerHelper('roi_pool', **locals())
+    dtype = helper.input_dtype()
+    out = helper.create_tmp_variable(dtype)
+    argmax = helper.create_tmp_variable('int32')  # x64 disabled under jax
+    helper.append_op(
+        type='roi_pool',
+        inputs={'X': [input], 'ROIs': [rois]},
+        outputs={'Out': [out], 'Argmax': [argmax]},
+        attrs={'pooled_height': pooled_height,
+               'pooled_width': pooled_width,
+               'spatial_scale': spatial_scale})
+    return out
+
+
+def detection_output(loc, conf, prior_box, num_classes,
+                     background_label_id=0, nms_threshold=0.45,
+                     confidence_threshold=0.01, nms_top_k=400,
+                     keep_top_k=200, **kwargs):
+    """SSD post-processing (ref operators/detection_output_op.cc): decode
+    prior boxes, per-class NMS, global top-k -> [N, keep_top_k, 6] rows
+    (label, score, xmin, ymin, xmax, ymax); label -1 pads."""
+    helper = LayerHelper('detection_output', **locals())
+    out = helper.create_tmp_variable('float32')
+    helper.append_op(
+        type='detection_output',
+        inputs={'Loc': [loc], 'Conf': [conf], 'PriorBox': [prior_box]},
+        outputs={'Out': [out]},
+        attrs={'num_classes': num_classes,
+               'background_label_id': background_label_id,
+               'nms_threshold': nms_threshold,
+               'confidence_threshold': confidence_threshold,
+               'nms_top_k': nms_top_k, 'keep_top_k': keep_top_k})
+    return out

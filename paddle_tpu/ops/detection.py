@@ -71,6 +71,79 @@ def nms_mask(boxes, scores, iou_threshold, score_threshold, max_keep):
     return keep
 
 
+@register_op('roi_pool')
+def _roi_pool(ctx, ins, attrs):
+    """RoI max pooling (reference paddle/operators/roi_pool_op.h).
+
+    X [N, C, H, W]; ROIs [R, 5] rows (batch_idx, x1, y1, x2, y2) in image
+    coordinates.  The reference walks each bin with data-dependent loop
+    bounds; the TPU design is dense: per (roi, bin) boolean masks over the
+    full H/W iotas, max-reduced in one vectorized pass (static shapes,
+    vmap over rois — gradient falls out of autodiff).  Outputs Out
+    [R, C, ph, pw] and Argmax (flat h*W+w, -1 for empty bins, parity with
+    the reference's argmax bookkeeping).
+    """
+    x = first(ins, 'X').astype(jnp.float32)
+    rois = first(ins, 'ROIs').astype(jnp.float32)
+    ph_n = int(attrs['pooled_height'])
+    pw_n = int(attrs['pooled_width'])
+    scale = float(attrs.get('spatial_scale', 1.0))
+    n, c, h, w = x.shape
+
+    hh = jnp.arange(h)
+    ww = jnp.arange(w)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # C round(): half away from zero; coords are non-negative
+        sw = jnp.floor(roi[1] * scale + 0.5).astype(jnp.int32)
+        sh = jnp.floor(roi[2] * scale + 0.5).astype(jnp.int32)
+        ew = jnp.floor(roi[3] * scale + 0.5).astype(jnp.int32)
+        eh = jnp.floor(roi[4] * scale + 0.5).astype(jnp.int32)
+        rh = jnp.maximum(eh - sh + 1, 1)  # malformed rois -> 1x1
+        rw = jnp.maximum(ew - sw + 1, 1)
+        bin_h = rh.astype(jnp.float32) / ph_n
+        bin_w = rw.astype(jnp.float32) / pw_n
+        ph_i = jnp.arange(ph_n, dtype=jnp.float32)
+        pw_i = jnp.arange(pw_n, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(ph_i * bin_h).astype(jnp.int32) + sh,
+                          0, h)
+        hend = jnp.clip(jnp.ceil((ph_i + 1) * bin_h).astype(jnp.int32) + sh,
+                        0, h)
+        wstart = jnp.clip(jnp.floor(pw_i * bin_w).astype(jnp.int32) + sw,
+                          0, w)
+        wend = jnp.clip(jnp.ceil((pw_i + 1) * bin_w).astype(jnp.int32) + sw,
+                        0, w)
+        hmask = (hh[None, :] >= hstart[:, None]) & \
+            (hh[None, :] < hend[:, None])      # [ph, H]
+        wmask = (ww[None, :] >= wstart[:, None]) & \
+            (ww[None, :] < wend[:, None])      # [pw, W]
+        feat = jnp.take(x, b, axis=0)          # [C, H, W]
+        # separable two-pass max keeps the peak at O(C*ph*H*W) instead of
+        # the joint O(C*ph*pw*H*W) mask (argmax tie-order can differ from
+        # the reference's h-major walk; exact-float ties only)
+        mh = jnp.where(hmask[None, :, :, None], feat[:, None, :, :],
+                       -jnp.inf)               # [C, ph, H, W]
+        col_max = jnp.max(mh, axis=2)          # [C, ph, W]
+        col_argh = jnp.argmax(mh, axis=2)      # [C, ph, W]
+        mw = jnp.where(wmask[None, None, :, :], col_max[:, :, None, :],
+                       -jnp.inf)               # [C, ph, pw, W]
+        out = jnp.max(mw, axis=-1)             # [C, ph, pw]
+        argw = jnp.argmax(mw, axis=-1)         # [C, ph, pw]
+        argh = jnp.take_along_axis(col_argh, argw, axis=-1)
+        # reference keeps int64 argmax; x64 is disabled under jax so int32
+        arg = (argh * w + argw).astype(jnp.int32)
+        empty = (hend <= hstart)[:, None] | (wend <= wstart)[None, :]
+        out = jnp.where(empty[None], 0.0, out)
+        arg = jnp.where(empty[None], -1, arg)
+        return out, arg
+
+    # sequential over rois (lax.map): each roi's pass is already wide
+    # enough to fill the chip, and vmap would multiply the peak by R
+    outs, args_ = jax.lax.map(one_roi, rois)
+    return {'Out': [outs], 'Argmax': [args_]}
+
+
 @register_op('detection_output')
 def _detection_output(ctx, ins, attrs):
     """Inputs: Loc [N, P, 4] offsets, Conf [N, P, C] logits,

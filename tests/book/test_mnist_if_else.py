@@ -1,0 +1,45 @@
+"""End-to-end: MNIST MLP whose hidden path is routed per-row by IfElse on
+label < 5 (reference fluid/tests/test_mnist_if_else_op.py).  Exercises
+training THROUGH the split/merge conditional: both branches own params and
+the merged rows carry gradients back to the branch that produced them.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+
+
+def test_mnist_if_else_trains():
+    image = fluid.layers.data(name='x', shape=[784], dtype='float32')
+    label = fluid.layers.data(name='y', shape=[1], dtype='int64')
+    limit = fluid.layers.fill_constant_batch_size_like(
+        input=label, shape=[-1, 1], dtype='int64', value=5)
+    cond = fluid.layers.less_than(x=label, y=limit)
+
+    ie = fluid.layers.IfElse(cond)
+    with ie.true_block():
+        img = ie.input(image)
+        hidden = fluid.layers.fc(input=img, size=64, act='tanh')
+        ie.output(fluid.layers.fc(input=hidden, size=10, act='softmax'))
+    with ie.false_block():
+        img = ie.input(image)
+        hidden = fluid.layers.fc(input=img, size=64, act='tanh')
+        ie.output(fluid.layers.fc(input=hidden, size=10, act='softmax'))
+    prob = ie()
+    loss = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=prob, label=label))
+    fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                              feed_list=[image, label])
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.mnist.train(), 256), batch_size=64)
+    costs = []
+    for epoch in range(4):
+        for batch in reader():
+            c, = exe.run(feed=feeder.feed(batch), fetch_list=[loss])
+            costs.append(float(np.ravel(c)[0]))
+    assert np.all(np.isfinite(costs))
+    assert costs[-1] < costs[0], costs

@@ -67,3 +67,84 @@ def test_detection_output_end_to_end():
     np.testing.assert_allclose(det[1, 2:], prior[0, :4], atol=1e-5)
     # padding rows have label -1
     assert np.all(out[0, 2:, 0] == -1)
+
+
+def _roi_pool_ref(x, rois, ph_n, pw_n, scale):
+    """Literal numpy re-statement of the reference loop semantics."""
+    import math
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    out = np.zeros((r, c, ph_n, pw_n), np.float32)
+    arg = np.full((r, c, ph_n, pw_n), -1, np.int64)
+    for i in range(r):
+        b = int(rois[i, 0])
+        sw = int(math.floor(rois[i, 1] * scale + 0.5))
+        sh = int(math.floor(rois[i, 2] * scale + 0.5))
+        ew = int(math.floor(rois[i, 3] * scale + 0.5))
+        eh = int(math.floor(rois[i, 4] * scale + 0.5))
+        rh = max(eh - sh + 1, 1)
+        rw = max(ew - sw + 1, 1)
+        bh, bw = rh / ph_n, rw / pw_n
+        for ci in range(c):
+            for ph in range(ph_n):
+                for pw in range(pw_n):
+                    hs = min(max(int(math.floor(ph * bh)) + sh, 0), h)
+                    he = min(max(int(math.ceil((ph + 1) * bh)) + sh, 0), h)
+                    ws = min(max(int(math.floor(pw * bw)) + sw, 0), w)
+                    we = min(max(int(math.ceil((pw + 1) * bw)) + sw, 0), w)
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = x[b, ci, hs:he, ws:we]
+                    out[i, ci, ph, pw] = patch.max()
+                    fl = np.argmax(patch)
+                    arg[i, ci, ph, pw] = \
+                        (hs + fl // (we - ws)) * w + ws + fl % (we - ws)
+    return out, arg
+
+
+def test_roi_pool_matches_reference_loop():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 3, 8, 10)).astype('float32')
+    rois = np.array([[0, 1, 1, 6, 5],
+                     [1, 0, 0, 9, 7],
+                     [0, 4, 2, 4, 2],    # degenerate 1x1 roi
+                     [1, 6, 3, 2, 1]],   # malformed (end < start)
+                    np.float32)
+    ph_n, pw_n, scale = 3, 2, 1.0
+    outs = run_op('roi_pool', {'X': x, 'ROIs': rois},
+                  {'pooled_height': ph_n, 'pooled_width': pw_n,
+                   'spatial_scale': scale})
+    ref_out, ref_arg = _roi_pool_ref(x, rois, ph_n, pw_n, scale)
+    np.testing.assert_allclose(np.asarray(outs['Out'][0]), ref_out,
+                               rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(outs['Argmax'][0]), ref_arg)
+
+
+def test_roi_pool_spatial_scale_and_grad():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(1, 2, 6, 6)).astype('float32')
+    rois = np.array([[0, 2, 2, 10, 10]], np.float32)  # scaled by 0.5 -> 1..5
+    outs = run_op('roi_pool', {'X': x, 'ROIs': rois},
+                  {'pooled_height': 2, 'pooled_width': 2,
+                   'spatial_scale': 0.5})
+    ref_out, _ = _roi_pool_ref(x, rois, 2, 2, 0.5)
+    np.testing.assert_allclose(np.asarray(outs['Out'][0]), ref_out,
+                               rtol=1e-5)
+    # gradient: max-pool style — d(sum(out))/dx is 1 at each bin argmax
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_impl
+    impl = get_op_impl('roi_pool')
+
+    class _Ctx:
+        pass
+
+    def f(xv):
+        o = impl.compute(_Ctx(), {'X': [xv], 'ROIs': [jnp.asarray(rois)]},
+                         {'pooled_height': 2, 'pooled_width': 2,
+                          'spatial_scale': 0.5})
+        return jnp.sum(o['Out'][0])
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    assert g.shape == x.shape
+    assert g.sum() == 8.0  # 2 channels x 2x2 bins, one winner per bin

@@ -157,10 +157,12 @@ def test_batchnorm_conv_model_matches_single_device_on_mesh():
     np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize('model', ['mnist_conv', 'word2vec'])
+@pytest.mark.parametrize('model', ['mnist_conv', 'word2vec',
+                                   'sentiment_conv', 'srl'])
 def test_book_models_on_mesh(model):
-    """Two book models take real dp-sharded steps on the 8-device mesh
-    and the loss decreases (reference book_distribute)."""
+    """Book models (mnist conv, word2vec, sentiment conv, SRL
+    BiLSTM-CRF) take real dp-sharded steps on the 8-device mesh and the
+    loss decreases (reference book_distribute)."""
     need_devices(8)
     main = fluid.Program()
     startup = fluid.Program()
@@ -173,6 +175,31 @@ def test_book_models_on_mesh(model):
             img, label, predict, loss, acc = mnist.build('conv')
             fixed = {'img': r.randn(16, 1, 28, 28).astype('float32'),
                      'label': r.randint(0, 10, (16, 1)).astype('int64')}
+        elif model == 'srl':
+            from paddle_tpu.models import srl
+            feeds_vars, feature_out, crf_decode, loss = srl.build(
+                word_dict_len=50, pred_dict_len=50, mark_dict_len=2,
+                label_dict_len=10)
+            feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                                      feed_list=feeds_vars, program=main)
+            rows = []
+            for _ in range(16):
+                T = int(r.randint(3, 7))
+                seqs = [r.randint(0, 50, T).tolist() for _ in range(7)]
+                seqs.append(r.randint(0, 2, T).tolist())    # mark
+                seqs.append(r.randint(0, 10, T).tolist())   # target labels
+                rows.append(tuple(seqs))
+            fixed = feeder.feed(rows)
+        elif model == 'sentiment_conv':
+            from paddle_tpu.models import sentiment
+            data, label, loss, acc, pred = sentiment.build(
+                input_dim=100, net='conv')
+            feeder = fluid.DataFeeder(place=fluid.CPUPlace(),
+                                      feed_list=[data, label],
+                                      program=main)
+            rows = [(r.randint(0, 100, r.randint(3, 9)).tolist(),
+                     int(r.randint(0, 2))) for _ in range(16)]
+            fixed = feeder.feed(rows)
         else:
             from paddle_tpu.models import word2vec
             words, next_word, predict, loss = word2vec.build(dict_size=100)

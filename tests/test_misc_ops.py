@@ -133,3 +133,14 @@ def test_is_empty_and_get_places():
     full = np.zeros((2, 3), 'float32')
     got2 = np.asarray(run_op('is_empty', {'X': full})['Out'][0])
     assert not bool(np.ravel(got2)[0])
+
+
+def test_get_places_layer():
+    # layers.device.get_places parity (ref fluid/layers/device.py)
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.get_places(device_count=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={}, fetch_list=[p])
+    np.testing.assert_array_equal(np.asarray(got), np.arange(4))
