@@ -21,6 +21,6 @@ def _flash_attention(ctx, ins, attrs):
         q, k, v,
         causal=attrs.get('causal', False),
         scale=attrs.get('scale', None),
-        block_q=attrs.get('block_q', 128),
-        block_k=attrs.get('block_k', 128))
+        block_q=attrs.get('block_q', 512),
+        block_k=attrs.get('block_k', 512))
     return out(y.astype(q.dtype))

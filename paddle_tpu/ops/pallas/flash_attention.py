@@ -7,14 +7,19 @@ running (max, sum, acc) state in VMEM across K blocks — O(block) memory,
 one HBM pass — the bandwidth-bound fusion XLA does not do by itself.
 
 Forward is the Pallas kernel (grid = (batch*heads, q blocks, k blocks),
-VMEM scratch carries m/l/acc between k iterations).  Backward is the
-standard flash recompute in plain jax (lax.scan over K blocks with the
-saved logsumexp) — O(T·block) memory, no score matrix.
+VMEM scratch carries m/l/acc between k iterations).  Backward on TPU is
+a pair of Pallas kernels (dk/dv: grid (bh, nk, nq); dq: grid (bh, nq,
+nk)) recomputing p from the saved logsumexp in VMEM; off-TPU it falls
+back to a jax lax.scan flash recompute.  Causal grids skip fully-masked
+tiles.  Env gates (trace-time): PADDLE_TPU_FLASH_BWD_SCAN forces the
+scan path on TPU, PADDLE_TPU_FLASH_BWD_PALLAS runs the Pallas backward
+in interpret mode off-TPU (how CPU CI exercises the kernel path).
 
-On non-TPU backends the kernel runs with interpret=True, so the same
-code path is exercised by CPU CI.
+On non-TPU backends the forward kernel runs with interpret=True, so the
+same code path is exercised by CPU CI.
 """
 import functools
+import os
 
 import numpy as _np
 
@@ -40,36 +45,45 @@ def _fa_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr[...])
         acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)  # [bk, d]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    kpos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    valid = kpos < tk  # last block may be padding past the real length
+    # causal dead-tile skip: tile fully masked when its newest query
+    # precedes its oldest key — costs one predicate, halves causal work
+    alive = True
     if causal:
-        # global positions: scalar-prefetched offsets shift the local
-        # indices, so causal masking works across ring-rotated K blocks
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        valid = valid & ((qoff_ref[0] + qpos) >= (koff_ref[0] + kpos))
-    s = jnp.where(valid, s, _NEG_INF)
+        alive = (qoff_ref[0] + qi * block_q + block_q - 1) >= \
+            (koff_ref[0] + ki * block_k)
 
-    m_prev = m_scr[:, 0]  # [bq]
-    l_prev = l_scr[:, 0]
-    m_cur = jnp.max(s, axis=1)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    # explicit zero for masked entries: when a whole row is masked,
-    # s == m_new == _NEG_INF and bare exp(s - m_new) would be 1
-    p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
-    l_new = l_prev * alpha + jnp.sum(p, axis=1)
-    acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+    @pl.when(alive)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = kpos < tk  # last block may be padding past the real length
+        if causal:
+            # global positions: scalar-prefetched offsets shift the local
+            # indices, so causal masking works across ring-rotated K blocks
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & ((qoff_ref[0] + qpos) >= (koff_ref[0] + kpos))
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]  # [bq]
+        l_prev = l_scr[:, 0]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit zero for masked entries: when a whole row is masked,
+        # s == m_new == _NEG_INF and bare exp(s - m_new) would be 1
+        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -226,6 +240,181 @@ def _fa_backward(causal, scale, block_k, res, do, dlse=None):
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
+def _bwd_common(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, *, scale,
+                causal, q0, k0, tq, tk, qoff, koff, bq, bk):
+    """Shared per-tile flash backward math: returns\n    (q, do, k, p, ds) with p/ds [bq, bk] fp32."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [bq, 1] sublane vector
+    di = di_ref[0, 0]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = (qpos < tq) & (kpos < tk)  # block padding rows/cols
+    if causal:
+        valid = valid & ((qoff + qpos) >= (koff + kpos))
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - di) * scale
+    return q, do, k, p, ds
+
+
+def _fa_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
+                       k_ref, v_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                       scale, causal, block_q, block_k, nq, tq, tk):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)  # innermost: accumulate over q blocks
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    # causal dead-tile skip: the whole tile is masked when its newest
+    # query precedes its oldest key
+    alive = True
+    if causal:
+        alive = (qoff_ref[0] + qi * block_q + block_q - 1) >= \
+            (koff_ref[0] + ki * block_k)
+
+    @pl.when(alive)
+    def _compute():
+        q, do, k, p, ds = _bwd_common(
+            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, scale=scale,
+            causal=causal, q0=qi * block_q, k0=ki * block_k, tq=tq, tk=tk,
+            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
+                      k_ref, v_ref, dq_ref, dq_scr, *, scale, causal,
+                      block_q, block_k, nk, tq, tk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)  # innermost: accumulate over k blocks
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+
+    alive = True
+    if causal:
+        alive = (qoff_ref[0] + qi * block_q + block_q - 1) >= \
+            (koff_ref[0] + ki * block_k)
+
+    @pl.when(alive)
+    def _compute():
+        _q, _do, k, p, ds = _bwd_common(
+            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, scale=scale,
+            causal=causal, q0=qi * block_q, k0=ki * block_k, tq=tq, tk=tk,
+            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _fa_backward_pallas(causal, scale, block_q, block_k, res, do,
+                        dlse, interpret):
+    """Pallas flash backward: dk/dv kernel (grid bh, nk, nq) and dq
+    kernel (grid bh, nq, nk), both recomputing p from the saved lse in
+    VMEM — the [Tq, Tk] lattice never touches HBM (the jax-scan fallback
+    `_fa_backward` streams [Tq, block_k] slabs through HBM instead)."""
+    q, k, v, q_off, k_off, o, lse = res
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
+    nq = pl.cdiv(tq, bq)
+    nk = pl.cdiv(tk, bk)
+    tq_p, tk_p = nq * bq, nk * bk
+
+    dof = do.astype(jnp.float32)
+    di = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [BH, Tq]
+    if dlse is not None:
+        di = di - dlse.astype(jnp.float32)
+
+    qp = jnp.pad(q, ((0, 0), (0, tq_p - tq), (0, 0)))
+    dop = jnp.pad(do, ((0, 0), (0, tq_p - tq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0)))
+    # lse/di ride as [BH, nq, bq, 1] sublane-vector blocks: 512B per
+    # tile visit instead of the 64KB a 128-lane broadcast would re-read
+    lse_b = jnp.pad(lse, ((0, 0), (0, tq_p - tq))).reshape(
+        bh, nq, bq, 1)
+    di_b = jnp.pad(di, ((0, 0), (0, tq_p - tq))).reshape(bh, nq, bq, 1)
+
+    qoff = jnp.asarray([0 if q_off is None else q_off], jnp.int32)
+    koff = jnp.asarray([0 if k_off is None else k_off], jnp.int32)
+
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, j, i, *_: (b, i, 0, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, j, i, *_: (b, i, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq, tq=tq, tk=tk),
+        grid_spec=dkv_spec,
+        out_shape=[_sds((bh, tk_p, d), k.dtype),
+                   _sds((bh, tk_p, d), v.dtype)],
+        interpret=interpret,
+    )(qoff, koff, qp, dop, lse_b, di_b, kp, vp)
+
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, i, j, *_: (b, i, 0, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, i, j, *_: (b, i, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0))],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    dq, = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk, tq=tq, tk=tk),
+        grid_spec=dq_spec,
+        out_shape=[_sds((bh, tq_p, d), q.dtype)],
+        interpret=interpret,
+    )(qoff, koff, qp, dop, lse_b, di_b, kp, vp)
+
+    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def _flash_with_lse(q, k, v, q_off, k_off, causal, scale, block_q,
                     block_k):
@@ -245,8 +434,21 @@ def _flash_fwd(q, k, v, q_off, k_off, causal, scale, block_q, block_k):
 
 
 def _flash_bwd(causal, scale, block_q, block_k, res, cts):
+    # env knobs are read at TRACE time (the vjp is cached under jit):
+    # toggling them mid-process needs jax.clear_caches().
+    # PADDLE_TPU_FLASH_BWD_SCAN forces the jax-scan path on TPU (A/B
+    # numerics); PADDLE_TPU_FLASH_BWD_PALLAS forces the Pallas kernels
+    # (interpret mode) off-TPU.
     do, dlse = cts
-    dq, dk, dv = _fa_backward(causal, scale, block_k, res, do, dlse)
+    on_tpu = jax.default_backend() == 'tpu'
+    force_scan = bool(os.environ.get('PADDLE_TPU_FLASH_BWD_SCAN'))
+    if (on_tpu and not force_scan) or \
+            os.environ.get('PADDLE_TPU_FLASH_BWD_PALLAS'):
+        dq, dk, dv = _fa_backward_pallas(causal, scale, block_q, block_k,
+                                         res, do, dlse,
+                                         interpret=not on_tpu)
+    else:  # CPU: the jax-scan recompute (fast under interpret-free jit)
+        dq, dk, dv = _fa_backward(causal, scale, block_k, res, do, dlse)
     f0 = _np.zeros((), jax.dtypes.float0)  # int operands: zero cotangent
     return dq, dk, dv, f0, f0
 
@@ -267,8 +469,8 @@ def _to_bhtd(q, k, v):
     return qf, kf, vf, (b, h, tq, d)
 
 
-def attention_with_lse(q, k, v, causal=False, scale=None, block_q=128,
-                       block_k=128, q_offset=0, k_offset=0):
+def attention_with_lse(q, k, v, causal=False, scale=None, block_q=512,
+                       block_k=512, q_offset=0, k_offset=0):
     """Fused attention returning (o, lse) for online-softmax merging
     (ring attention's local blocks).  q/k/v [B, T, H, D] -> o same shape,
     lse [B, H, T].  Differentiable.  q_offset/k_offset (traced int ok)
@@ -288,12 +490,15 @@ def attention_with_lse(q, k, v, causal=False, scale=None, block_q=128,
     return o, lse.reshape(b, h, tq)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512):
     """Fused attention over [B, T, H, D] (or [BH, T, D]) tensors.
 
     Returns softmax(q k^T * scale [+ causal mask]) v with O(block) live
-    memory on-chip.  Differentiable (flash recompute backward).
+    memory on-chip.  Differentiable (Pallas backward on TPU, flash
+    recompute scan elsewhere).  512 blocks: ~3.5x over 128 on v5e
+    fwd+bwd (s tile is 1MB VMEM; 2048 overflows Mosaic, 1024 regresses
+    at head_dim 128).
     """
     squeeze = False
     if q.ndim == 3:
