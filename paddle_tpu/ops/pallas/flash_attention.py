@@ -33,6 +33,16 @@ __all__ = ['flash_attention']
 _NEG_INF = -1e30
 
 
+def _env_on(name):
+    return os.environ.get(name, '') not in ('', '0')
+
+
+def _tile_alive(qoff, koff, qi, ki, block_q, block_k):
+    """Causal dead-tile predicate shared by fwd/dkv/dq kernels: the tile
+    is fully masked when its newest query precedes its oldest key."""
+    return (qoff + qi * block_q + block_q - 1) >= (koff + ki * block_k)
+
+
 def _fa_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
                nk, tk):
@@ -49,8 +59,8 @@ def _fa_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # precedes its oldest key — costs one predicate, halves causal work
     alive = True
     if causal:
-        alive = (qoff_ref[0] + qi * block_q + block_q - 1) >= \
-            (koff_ref[0] + ki * block_k)
+        alive = _tile_alive(qoff_ref[0], koff_ref[0], qi, ki,
+                            block_q, block_k)
 
     @pl.when(alive)
     def _compute():
@@ -278,8 +288,8 @@ def _fa_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
     # query precedes its oldest key
     alive = True
     if causal:
-        alive = (qoff_ref[0] + qi * block_q + block_q - 1) >= \
-            (koff_ref[0] + ki * block_k)
+        alive = _tile_alive(qoff_ref[0], koff_ref[0], qi, ki,
+                            block_q, block_k)
 
     @pl.when(alive)
     def _compute():
@@ -312,8 +322,8 @@ def _fa_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
 
     alive = True
     if causal:
-        alive = (qoff_ref[0] + qi * block_q + block_q - 1) >= \
-            (koff_ref[0] + ki * block_k)
+        alive = _tile_alive(qoff_ref[0], koff_ref[0], qi, ki,
+                            block_q, block_k)
 
     @pl.when(alive)
     def _compute():
@@ -441,9 +451,9 @@ def _flash_bwd(causal, scale, block_q, block_k, res, cts):
     # (interpret mode) off-TPU.
     do, dlse = cts
     on_tpu = jax.default_backend() == 'tpu'
-    force_scan = bool(os.environ.get('PADDLE_TPU_FLASH_BWD_SCAN'))
+    force_scan = _env_on('PADDLE_TPU_FLASH_BWD_SCAN')
     if (on_tpu and not force_scan) or \
-            os.environ.get('PADDLE_TPU_FLASH_BWD_PALLAS'):
+            _env_on('PADDLE_TPU_FLASH_BWD_PALLAS'):
         dq, dk, dv = _fa_backward_pallas(causal, scale, block_q, block_k,
                                          res, do, dlse,
                                          interpret=not on_tpu)

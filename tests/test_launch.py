@@ -46,3 +46,73 @@ def test_initialize_idempotent():
     launch.initialize()
     launch.initialize()  # second call is a no-op
     assert launch.is_initialized()
+
+
+def test_two_process_psum_over_dcn():
+    """True multi-process integration (reference: multi-node trainer
+    launch): two OS processes join via launch.initialize (our env
+    protocol), build one global mesh over both, and a psum crosses the
+    process boundary with the correct global sum."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+
+    code = textwrap.dedent('''
+        import os, sys
+        os.environ['XLA_FLAGS'] = \
+            '--xla_force_host_platform_device_count=2'
+        sys.path.insert(0, %r)
+        import jax
+        # the image's sitecustomize re-registers the TPU tunnel plugin
+        # and resets JAX_PLATFORMS after interpreter start; the config
+        # API wins (same dance as tests/conftest.py)
+        jax.config.update('jax_platforms', 'cpu')
+        from paddle_tpu.distributed import launch
+        launch.initialize()   # reads the PADDLE_TPU_* env protocol
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.parallel import collective
+        assert len(jax.devices()) == 4, jax.devices()
+        mesh = launch.global_mesh((4,), ('dp',))
+        x = jax.make_array_from_callback(
+            (4,), jax.NamedSharding(mesh, P('dp')),
+            lambda idx: np.arange(4, dtype=np.float32)[idx])
+        total = collective.shard_map(
+            lambda v: jax.lax.psum(v, 'dp'), mesh=mesh,
+            in_specs=P('dp'), out_specs=P())(x)
+        print('RANK%%s_SUM=%%.1f' %% (os.environ['PADDLE_TPU_PROC_ID'],
+                                      float(np.asarray(total)[0])),
+              flush=True)
+        launch.shutdown()
+    ''' % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ('JAX_PLATFORMS', 'XLA_FLAGS')}
+    procs = []
+    for rank in range(2):
+        env = dict(env_base,
+                   PADDLE_TPU_COORDINATOR='127.0.0.1:%d' % port,
+                   PADDLE_TPU_NUM_PROCS='2',
+                   PADDLE_TPU_PROC_ID=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, '-c', code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for rank, out in enumerate(outs):
+        assert 'RANK%d_SUM=6.0' % rank in out, (rank, out[-2000:])
