@@ -179,16 +179,15 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
                  use_peepholes=True, is_reverse=False,
                  gate_activation='sigmoid', cell_activation='tanh',
                  candidate_activation='tanh', dtype='float32',
-                 use_pallas=False, **kwargs):
+                 use_pallas=True, **kwargs):
     """Parity with fluid.layers.dynamic_lstm: `input` is the pre-projected
     gate sequence [B, T, 4H] (from an fc of size 4*hidden).
 
-    use_pallas=True requests the fused VMEM-carry time-loop kernel
-    (ops/pallas/lstm_cell.py) — engaged on the TPU backend when the
-    config qualifies (full-length, forward, default activations, no
-    peepholes).  Best for inference/forward-heavy use: the backward
-    recomputes the scan formulation, so pure training steps gain
-    little over the default path."""
+    use_pallas (default True) requests the fused VMEM-carry time-loop
+    kernel (ops/pallas/lstm_cell.py) — engaged on the TPU backend when
+    the config qualifies (default activations, no chained h0/c0; ragged
+    and reversed batches included, peepholes included); other configs
+    and non-TPU backends silently use the identical lax.scan path."""
     helper = LayerHelper('lstm', **kwargs)
     hidden = size // 4
     from ..param_attr import ParamAttr
@@ -219,11 +218,13 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
                 is_reverse=False, gate_activation='sigmoid',
                 candidate_activation='tanh', h_0=None, dtype='float32',
-                use_pallas=False, **kwargs):
+                use_pallas=True, **kwargs):
     """Parity with fluid.layers.dynamic_gru: `input` is [B, T, 3H].
 
-    use_pallas=True requests the fused VMEM-carry time-loop kernel on the
-    TPU backend (full-length forward default-activation configs)."""
+    use_pallas (default True) engages the fused VMEM-carry time-loop
+    kernel on the TPU backend for default-activation configs without a
+    chained h_0 (ragged and reversed batches included); other configs
+    and non-TPU backends use the identical lax.scan path."""
     helper = LayerHelper('gru', **kwargs)
     hidden = size
     from ..param_attr import ParamAttr

@@ -8,13 +8,17 @@ iteration with the (h, c) carry living in VMEM scratch, so the time loop
 never round-trips the carry through HBM (TPU grid iterations run
 sequentially, which is exactly a scan).
 
-Forward: pallas kernel, grid=(T,), time-major [T, B, 4H] gate inputs.
-Backward: custom_vjp recomputes with the numerically-identical lax.scan
-(ops/rnn.py math) and differentiates that — exact grads, no hand-written
-backward-through-time kernel to maintain.
+Forward: pallas kernel, grid=(T,), time-major [T, B, 4H] gate inputs;
+post-activation gates are emitted as an extra f32 output.  Backward:
+hand-written reverse-time BPTT kernels — grid step idx processes
+t = T-1-idx with the (dh, dc) chain and the dW/dpw accumulators living
+in VMEM, replaying the saved gates instead of recomputing the forward
+(`_scan_reference` remains as the CI cross-check oracle).
 
-Masking/length handling stays in ops/rnn.py (the caller); this kernel
-computes the full-length unrolled recurrence.
+Masking/length handling stays in ops/rnn.py (the caller): lengths are
+prefixes, so the kernel runs the full-length unrolled recurrence and the
+caller zero-masks padded positions (fwd and bwd both exact — see the
+ops/rnn.py pallas branch comment).
 """
 import functools
 
@@ -26,7 +30,10 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ['lstm_scan', 'gru_scan']
 
 
-def _lstm_kernel(x_ref, w_ref, o_h_ref, o_c_ref, h_scr, c_scr, *, hidden):
+def _lstm_kernel(x_ref, w_ref, pw_ref, o_h_ref, o_c_ref, *o_g_and_scr,
+                 hidden, with_gates):
+    o_g_ref = o_g_and_scr[0] if with_gates else None
+    h_scr, c_scr = o_g_and_scr[-2:]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -36,36 +43,98 @@ def _lstm_kernel(x_ref, w_ref, o_h_ref, o_c_ref, h_scr, c_scr, *, hidden):
 
     g = x_ref[0].astype(jnp.float32)  # [B, 4H] pre-projected gates
     w = w_ref[...].astype(jnp.float32)  # [H, 4H]
+    pw = pw_ref[...].astype(jnp.float32)  # [3, H] peepholes (or zeros)
     h_p = h_scr[...]
     c_p = c_scr[...]
     g = g + jax.lax.dot_general(h_p, w, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-    i = jax.nn.sigmoid(g[:, :hidden])
-    f = jax.nn.sigmoid(g[:, hidden:2 * hidden])
+    i = jax.nn.sigmoid(g[:, :hidden] + c_p * pw[0:1, :])
+    f = jax.nn.sigmoid(g[:, hidden:2 * hidden] + c_p * pw[1:2, :])
     cand = jnp.tanh(g[:, 2 * hidden:3 * hidden])
-    o = jax.nn.sigmoid(g[:, 3 * hidden:])
     c = f * c_p + i * cand
+    o = jax.nn.sigmoid(g[:, 3 * hidden:] + c * pw[2:3, :])
     h = o * jnp.tanh(c)
     h_scr[...] = h
     c_scr[...] = c
     o_h_ref[0] = h.astype(o_h_ref.dtype)
     o_c_ref[0] = c.astype(o_c_ref.dtype)
+    if with_gates:
+        # post-activation gates saved f32 for the BPTT kernel's replay
+        o_g_ref[0] = jnp.concatenate([i, f, cand, o], axis=1)
 
 
-def _scan_reference(x_tm, w):
+def _lstm_bwd_kernel(gates_ref, c_ref, cprev_ref, hprev_ref, cth_ref,
+                     ctc_ref, w_ref, pw_ref, dx_ref, dw_ref, dpw_ref,
+                     dh_scr, dc_scr, dw_scr, dpw_scr, *, hidden, nt):
+    """Reverse-time BPTT: grid step idx processes t = nt-1-idx with the
+    (dh, dc) chain and the dW/dpw accumulators living in VMEM — no
+    forward recompute (gates/h/c come from the forward kernel)."""
+    idx = pl.program_id(0)
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr[...])
+        dc_scr[...] = jnp.zeros_like(dc_scr[...])
+        dw_scr[...] = jnp.zeros_like(dw_scr[...])
+        dpw_scr[...] = jnp.zeros_like(dpw_scr[...])
+
+    g = gates_ref[0]                      # [B, 4H] f32 (i, f, cand, o)
+    i = g[:, :hidden]
+    f = g[:, hidden:2 * hidden]
+    cand = g[:, 2 * hidden:3 * hidden]
+    o = g[:, 3 * hidden:]
+    c_t = c_ref[0].astype(jnp.float32)
+    c_p = cprev_ref[0].astype(jnp.float32)
+    h_p = hprev_ref[0].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    pw = pw_ref[...].astype(jnp.float32)
+
+    dh = cth_ref[0].astype(jnp.float32) + dh_scr[...]
+    tc = jnp.tanh(c_t)
+    do = dh * tc
+    dgo = do * o * (1.0 - o)
+    dc = ctc_ref[0].astype(jnp.float32) + dc_scr[...] + \
+        dh * o * (1.0 - tc * tc) + dgo * pw[2:3, :]
+    di = dc * cand
+    df = dc * c_p
+    dcand = dc * i
+    dgi = di * i * (1.0 - i)
+    dgf = df * f * (1.0 - f)
+    dgc = dcand * (1.0 - cand * cand)
+    dg = jnp.concatenate([dgi, dgf, dgc, dgo], axis=1)  # [B, 4H]
+    dx_ref[0] = dg.astype(dx_ref.dtype)
+    dw_scr[...] += jax.lax.dot_general(
+        h_p, dg, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dpw_scr[0:1, :] += jnp.sum(dgi * c_p, axis=0, keepdims=True)
+    dpw_scr[1:2, :] += jnp.sum(dgf * c_p, axis=0, keepdims=True)
+    dpw_scr[2:3, :] += jnp.sum(dgo * c_t, axis=0, keepdims=True)
+    dh_scr[...] = jax.lax.dot_general(
+        dg, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_scr[...] = dc * f + dgi * pw[0:1, :] + dgf * pw[1:2, :]
+
+    @pl.when(idx == nt - 1)
+    def _finish():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+        dpw_ref[...] = dpw_scr[...].astype(dpw_ref.dtype)
+
+
+def _scan_reference(x_tm, w, pw):
     """The identical recurrence as a lax.scan (the backward path)."""
     hdim = w.shape[0]
+    pwf = pw.astype(jnp.float32)
 
     def step(carry, g_t):
         h_p, c_p = carry
         g = g_t.astype(jnp.float32) + jnp.matmul(
             h_p, w.astype(jnp.float32),
             preferred_element_type=jnp.float32)
-        i = jax.nn.sigmoid(g[:, :hdim])
-        f = jax.nn.sigmoid(g[:, hdim:2 * hdim])
+        i = jax.nn.sigmoid(g[:, :hdim] + c_p * pwf[0:1, :])
+        f = jax.nn.sigmoid(g[:, hdim:2 * hdim] + c_p * pwf[1:2, :])
         cand = jnp.tanh(g[:, 2 * hdim:3 * hdim])
-        o = jax.nn.sigmoid(g[:, 3 * hdim:])
         c = f * c_p + i * cand
+        o = jax.nn.sigmoid(g[:, 3 * hdim:] + c * pwf[2:3, :])
         h = o * jnp.tanh(c)
         return (h, c), (h, c)
 
@@ -76,54 +145,127 @@ def _scan_reference(x_tm, w):
     return hs.astype(x_tm.dtype), cs.astype(x_tm.dtype)
 
 
-@jax.custom_vjp
-def lstm_scan(x_tm, w):
+def lstm_scan(x_tm, w, pw=None):
     """Fused LSTM over time-major gates x_tm [T, B, 4H], recurrent weight
-    w [H, 4H]; zero initial state.  Returns (hs, cs) [T, B, H] each."""
+    w [H, 4H], optional peephole weights pw [3, H] (w_ic, w_fc, w_oc);
+    zero initial state.  Returns (hs, cs) [T, B, H] each."""
+    if pw is None:
+        pw = jnp.zeros((3, w.shape[0]), jnp.float32)
+    return _lstm_scan_core(x_tm, w, pw)
+
+
+def _lstm_forward(x_tm, w, pw, with_gates):
+    """with_gates=True also emits the f32 post-activation gates the BPTT
+    kernel replays; the primal (no-grad) path skips that HBM write."""
     t, b, four_h = x_tm.shape
     hidden = four_h // 4
     interpret = jax.default_backend() != 'tpu'
-    kernel = functools.partial(_lstm_kernel, hidden=hidden)
-    hs, cs = pl.pallas_call(
+    kernel = functools.partial(_lstm_kernel, hidden=hidden,
+                               with_gates=with_gates)
+    out_specs = [
+        pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
+        jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
+    ]
+    if with_gates:
+        out_specs.append(pl.BlockSpec((1, b, four_h),
+                                      lambda i: (i, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((t, b, four_h),
+                                              jnp.float32))
+    return pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
             pl.BlockSpec((1, b, four_h), lambda i: (i, 0, 0)),
             pl.BlockSpec((hidden, four_h), lambda i: (0, 0)),
+            pl.BlockSpec((3, hidden), lambda i: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
-            jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((b, hidden), jnp.float32),
             pltpu.VMEM((b, hidden), jnp.float32),
         ],
         interpret=interpret,
-    )(x_tm, w)
+    )(x_tm, w, pw)
+
+
+def _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c):
+    t, b, four_h = gates.shape
+    hidden = four_h // 4
+    interpret = jax.default_backend() != 'tpu'
+    zrow = jnp.zeros((1, b, hidden), hs.dtype)
+    h_prev = jnp.concatenate([zrow, hs[:-1]], axis=0)
+    c_prev = jnp.concatenate([zrow, cs[:-1]], axis=0)
+    rev = lambda i: (t - 1 - i, 0, 0)
+    kernel = functools.partial(_lstm_bwd_kernel, hidden=hidden, nt=t)
+    dx, dw, dpw = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, four_h), rev),    # gates
+            pl.BlockSpec((1, b, hidden), rev),    # c_t
+            pl.BlockSpec((1, b, hidden), rev),    # c_{t-1}
+            pl.BlockSpec((1, b, hidden), rev),    # h_{t-1}
+            pl.BlockSpec((1, b, hidden), rev),    # ct_h
+            pl.BlockSpec((1, b, hidden), rev),    # ct_c
+            pl.BlockSpec((hidden, four_h), lambda i: (0, 0)),
+            pl.BlockSpec((3, hidden), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, four_h), rev),
+            pl.BlockSpec((hidden, four_h), lambda i: (0, 0)),
+            pl.BlockSpec((3, hidden), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, four_h), jnp.float32),
+            jax.ShapeDtypeStruct((hidden, four_h), jnp.float32),
+            jax.ShapeDtypeStruct((3, hidden), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((hidden, four_h), jnp.float32),
+            pltpu.VMEM((3, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gates, cs, c_prev, h_prev, ct_h, ct_c, w, pw)
+    return dx, dw, dpw
+
+
+@jax.custom_vjp
+def _lstm_scan_core(x_tm, w, pw):
+    hs, cs = _lstm_forward(x_tm, w, pw, with_gates=False)
     return hs, cs
 
 
-def _fwd(x_tm, w):
-    return lstm_scan(x_tm, w), (x_tm, w)
+def _fwd(x_tm, w, pw):
+    hs, cs, gates = _lstm_forward(x_tm, w, pw, with_gates=True)
+    # zero-size token carries x's dtype (residuals must be jax types)
+    x_tok = jnp.empty((0,), x_tm.dtype)
+    return (hs, cs), (x_tok, w, pw, hs, cs, gates)
 
 
 def _bwd(res, cts):
-    # exact grads by differentiating the identical scan formulation
-    x_tm, w = res
-    _, vjp = jax.vjp(_scan_reference, x_tm, w)
-    return vjp(cts)
+    # hand-written reverse-time kernel over the saved forward state —
+    # no recompute pass (cf. the scan path, which re-runs the forward)
+    x_tok, w, pw, hs, cs, gates = res
+    ct_h, ct_c = cts
+    dx, dw, dpw = _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c)
+    return (dx.astype(x_tok.dtype), dw.astype(w.dtype),
+            dpw.astype(pw.dtype))
 
 
-lstm_scan.defvjp(_fwd, _bwd)
+_lstm_scan_core.defvjp(_fwd, _bwd)
 
 
 # ---------------------------------------------------------------- GRU
-def _gru_kernel(x_ref, w_ref, o_ref, h_scr, *, hidden):
+def _gru_kernel(x_ref, w_ref, o_ref, *o_g_and_scr, hidden, with_gates):
+    o_g_ref = o_g_and_scr[0] if with_gates else None
+    h_scr = o_g_and_scr[-1]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -144,6 +286,57 @@ def _gru_kernel(x_ref, w_ref, o_ref, h_scr, *, hidden):
     h = u * h_p + (1.0 - u) * c
     h_scr[...] = h
     o_ref[0] = h.astype(o_ref.dtype)
+    if with_gates:
+        # post-activation gates saved f32 for the BPTT kernel's replay
+        o_g_ref[0] = jnp.concatenate([u, r, c], axis=1)
+
+
+def _gru_bwd_kernel(gates_ref, hprev_ref, cth_ref, w_ref, dx_ref, dw_ref,
+                    dh_scr, dw_scr, *, hidden, nt):
+    """Reverse-time GRU BPTT: grid step idx processes t = nt-1-idx; the
+    dh chain and dW accumulator live in VMEM (no forward recompute)."""
+    idx = pl.program_id(0)
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr[...])
+        dw_scr[...] = jnp.zeros_like(dw_scr[...])
+
+    g = gates_ref[0]                       # [B, 3H] f32 (u, r, c)
+    u = g[:, :hidden]
+    r = g[:, hidden:2 * hidden]
+    c = g[:, 2 * hidden:]
+    h_p = hprev_ref[0].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    w_rz = w[:, :2 * hidden]
+    w_c = w[:, 2 * hidden:]
+
+    dh = cth_ref[0].astype(jnp.float32) + dh_scr[...]
+    du = dh * (h_p - c)
+    dc = dh * (1.0 - u)
+    dc_pre = dc * (1.0 - c * c)
+    drh = jax.lax.dot_general(                 # d(r*h_p) [B, H]
+        dc_pre, w_c, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dr = drh * h_p
+    du_pre = du * u * (1.0 - u)
+    dr_pre = dr * r * (1.0 - r)
+    dg_rz = jnp.concatenate([du_pre, dr_pre], axis=1)  # [B, 2H]
+    dx_ref[0] = jnp.concatenate([dg_rz, dc_pre],
+                                axis=1).astype(dx_ref.dtype)
+    dw_scr[:, :2 * hidden] += jax.lax.dot_general(
+        h_p, dg_rz, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dw_scr[:, 2 * hidden:] += jax.lax.dot_general(
+        r * h_p, dc_pre, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dh_scr[...] = dh * u + drh * r + jax.lax.dot_general(
+        dg_rz, w_rz, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(idx == nt - 1)
+    def _finish():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
 
 
 def _gru_scan_reference(x_tm, w):
@@ -168,37 +361,88 @@ def _gru_scan_reference(x_tm, w):
     return hs.astype(x_tm.dtype)
 
 
-@jax.custom_vjp
-def gru_scan(x_tm, w):
-    """Fused GRU over time-major gates x_tm [T, B, 3H], recurrent weight
-    w [H, 3H] ([:, :2H] update/reset, [:, 2H:] candidate); zero initial
-    state.  Returns hs [T, B, H]."""
+def _gru_forward(x_tm, w, with_gates):
     t, b, three_h = x_tm.shape
     hidden = three_h // 3
     interpret = jax.default_backend() != 'tpu'
-    kernel = functools.partial(_gru_kernel, hidden=hidden)
-    return pl.pallas_call(
+    kernel = functools.partial(_gru_kernel, hidden=hidden,
+                               with_gates=with_gates)
+    out_specs = [pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype)]
+    if with_gates:
+        out_specs.append(pl.BlockSpec((1, b, three_h),
+                                      lambda i: (i, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((t, b, three_h),
+                                              jnp.float32))
+    out = pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
             pl.BlockSpec((1, b, three_h), lambda i: (i, 0, 0)),
             pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((b, hidden), jnp.float32)],
         interpret=interpret,
     )(x_tm, w)
+    return out if with_gates else (out[0], None)
+
+
+def _gru_backward(w, hs, gates, ct_h):
+    t, b, three_h = gates.shape
+    hidden = three_h // 3
+    interpret = jax.default_backend() != 'tpu'
+    zrow = jnp.zeros((1, b, hidden), hs.dtype)
+    h_prev = jnp.concatenate([zrow, hs[:-1]], axis=0)
+    rev = lambda i: (t - 1 - i, 0, 0)
+    kernel = functools.partial(_gru_bwd_kernel, hidden=hidden, nt=t)
+    dx, dw = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, three_h), rev),   # gates (u, r, c)
+            pl.BlockSpec((1, b, hidden), rev),    # h_{t-1}
+            pl.BlockSpec((1, b, hidden), rev),    # ct_h
+            pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, three_h), rev),
+            pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, three_h), jnp.float32),
+            jax.ShapeDtypeStruct((hidden, three_h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((hidden, three_h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gates, h_prev, ct_h, w)
+    return dx, dw
+
+
+@jax.custom_vjp
+def gru_scan(x_tm, w):
+    """Fused GRU over time-major gates x_tm [T, B, 3H], recurrent weight
+    w [H, 3H] ([:, :2H] update/reset, [:, 2H:] candidate); zero initial
+    state.  Returns hs [T, B, H]."""
+    hs, _ = _gru_forward(x_tm, w, with_gates=False)
+    return hs
 
 
 def _gru_fwd(x_tm, w):
-    return gru_scan(x_tm, w), (x_tm, w)
+    hs, gates = _gru_forward(x_tm, w, with_gates=True)
+    x_tok = jnp.empty((0,), x_tm.dtype)
+    return hs, (x_tok, w, hs, gates)
 
 
 def _gru_bwd(res, ct):
-    x_tm, w = res
-    _, vjp = jax.vjp(_gru_scan_reference, x_tm, w)
-    return vjp(ct)
+    # reverse-time BPTT kernel over the saved forward state
+    x_tok, w, hs, gates = res
+    dx, dw = _gru_backward(w, hs, gates, ct)
+    return dx.astype(x_tok.dtype), dw.astype(w.dtype)
 
 
 gru_scan.defvjp(_gru_fwd, _gru_bwd)

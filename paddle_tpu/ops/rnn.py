@@ -25,6 +25,21 @@ def _gate_act(name):
     }[name]
 
 
+def _maybe_reverse(xf, lengths, is_reverse):
+    """Reverse each row's valid prefix (padded tail stays in place).
+    Returns (x, rev_idx) with rev_idx None when not reversing — the same
+    gather applied to the outputs undoes it."""
+    if not is_reverse:
+        return xf, None
+    b, t = xf.shape[0], xf.shape[1]
+    ln = (jnp.full((b,), t, jnp.int32) if lengths is None
+          else lengths.astype(jnp.int32).reshape(-1))
+    idx = jnp.arange(t)
+    rev_idx = jnp.where(idx[None, :] < ln[:, None],
+                        ln[:, None] - 1 - idx[None, :], idx[None, :])
+    return jnp.take_along_axis(xf, rev_idx[..., None], axis=1), rev_idx
+
+
 @register_op('lstm')
 def _lstm(ctx, ins, attrs):
     """Dynamic LSTM over a padded batch (operators/lstm_op.cc).  Input is
@@ -48,23 +63,40 @@ def _lstm(ctx, ins, attrs):
     if bias is not None:
         xf = xf + bias.astype(jnp.float32)[..., :4 * h].reshape(1, 1, -1)
 
-    if attrs.get('use_pallas') and lengths is None and h0 is None and \
-            c0 is None and not attrs.get('is_reverse', False) and \
+    if attrs.get('use_pallas') and h0 is None and c0 is None and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('cell_activation', 'tanh') == 'tanh' and \
             attrs.get('candidate_activation', 'tanh') == 'tanh' and \
-            not use_peepholes and \
             (jax.default_backend() == 'tpu' or
              attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.py): carry lives
-        # in VMEM across grid steps.  TPU-only (interpret mode would
-        # unroll all T steps); falls back to the lax.scan path for
-        # ragged/reversed/peephole/custom-activation configs.
+        # in VMEM across grid steps; backward is the reverse-time BPTT
+        # kernel.  TPU-only (interpret mode would unroll all T steps);
+        # falls back to the lax.scan path for custom-activation or
+        # chained-h0/c0 configs (peepholes ride the kernel via
+        # pw = Bias[4H:7H]).  Ragged batches run the kernel UNMASKED:
+        # lengths are prefixes, so padded steps can't reach any valid
+        # output, and the zero-mask below (whose vjp zeroes the padded
+        # cotangents) makes fwd and bwd exactly match the masked scan.
         from .pallas.lstm_cell import lstm_scan
+        xin, rev_idx = _maybe_reverse(xf, lengths,
+                                      attrs.get('is_reverse', False))
+        pw = (bias.astype(jnp.float32).reshape(-1)[4 * h:7 * h]
+              .reshape(3, h) if use_peepholes else None)
         # kernel gate order (i, f, cand, o) == this op's (i, f, c, o)
-        hs, cs = lstm_scan(jnp.swapaxes(xf, 0, 1), w)
-        return {'Hidden': [jnp.swapaxes(hs, 0, 1).astype(x.dtype)],
-                'Cell': [jnp.swapaxes(cs, 0, 1).astype(x.dtype)]}
+        hs, cs = lstm_scan(jnp.swapaxes(xin, 0, 1), w, pw)
+        hs = jnp.swapaxes(hs, 0, 1)
+        cs = jnp.swapaxes(cs, 0, 1)
+        if rev_idx is not None:
+            hs = jnp.take_along_axis(hs, rev_idx[..., None], axis=1)
+            cs = jnp.take_along_axis(cs, rev_idx[..., None], axis=1)
+        if lengths is not None:
+            mask = (jnp.arange(t)[None, :] <
+                    lengths.astype(jnp.int32).reshape(-1)[:, None])[..., None]
+            hs = jnp.where(mask, hs, 0.0)
+            cs = jnp.where(mask, cs, 0.0)
+        return {'Hidden': [hs.astype(x.dtype)],
+                'Cell': [cs.astype(x.dtype)]}
     if lengths is None:
         lengths = jnp.full((b,), t, jnp.int32)
     lengths = lengths.astype(jnp.int32).reshape(-1)
@@ -78,11 +110,7 @@ def _lstm(ctx, ins, attrs):
         w_ic, w_fc, w_oc = (bf[4 * h:5 * h], bf[5 * h:6 * h],
                             bf[6 * h:7 * h])
     if is_reverse:
-        # reverse each row's valid prefix
-        idx = jnp.arange(t)
-        rev_idx = jnp.where(idx[None, :] < lengths[:, None],
-                            lengths[:, None] - 1 - idx[None, :], idx[None, :])
-        xf = jnp.take_along_axis(xf, rev_idx[..., None], axis=1)
+        xf, rev_idx = _maybe_reverse(xf, lengths, True)
 
     h_prev = (h0.astype(jnp.float32) if h0 is not None
               else jnp.zeros((b, h), jnp.float32))
@@ -156,16 +184,24 @@ def _gru(ctx, ins, attrs):
     if bias is not None:
         xf = xf + bias.astype(jnp.float32).reshape(1, 1, -1)
 
-    if attrs.get('use_pallas') and lengths is None and h0 is None and \
-            not attrs.get('is_reverse', False) and \
+    if attrs.get('use_pallas') and h0 is None and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('activation', 'tanh') == 'tanh' and \
             (jax.default_backend() == 'tpu' or
              attrs.get('pallas_interpret', False)):
-        # fused Pallas time loop (ops/pallas/lstm_cell.gru_scan)
+        # fused Pallas time loop (ops/pallas/lstm_cell.gru_scan); ragged
+        # batches run unmasked + zero-mask outside (see the lstm branch)
         from .pallas.lstm_cell import gru_scan
-        hs = gru_scan(jnp.swapaxes(xf, 0, 1), w)
-        return {'Hidden': [jnp.swapaxes(hs, 0, 1).astype(x.dtype)]}
+        xin, rev_idx = _maybe_reverse(xf, lengths,
+                                      attrs.get('is_reverse', False))
+        hs = jnp.swapaxes(gru_scan(jnp.swapaxes(xin, 0, 1), w), 0, 1)
+        if rev_idx is not None:
+            hs = jnp.take_along_axis(hs, rev_idx[..., None], axis=1)
+        if lengths is not None:
+            mask = (jnp.arange(t)[None, :] <
+                    lengths.astype(jnp.int32).reshape(-1)[:, None])[..., None]
+            hs = jnp.where(mask, hs, 0.0)
+        return {'Hidden': [hs.astype(x.dtype)]}
 
     if lengths is None:
         lengths = jnp.full((b,), t, jnp.int32)
@@ -176,11 +212,7 @@ def _gru(ctx, ins, attrs):
     w_rz = w[:, :2 * h]
     w_c = w[:, 2 * h:]
     if is_reverse:
-        idx = jnp.arange(t)
-        rev_idx = jnp.where(idx[None, :] < lengths[:, None],
-                            lengths[:, None] - 1 - idx[None, :],
-                            idx[None, :])
-        xf = jnp.take_along_axis(xf, rev_idx[..., None], axis=1)
+        xf, rev_idx = _maybe_reverse(xf, lengths, True)
 
     h_prev = (h0.astype(jnp.float32) if h0 is not None
               else jnp.zeros((b, h), jnp.float32))

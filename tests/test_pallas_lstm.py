@@ -40,7 +40,7 @@ def test_lstm_scan_grads_match_scan():
     from paddle_tpu.ops.pallas.lstm_cell import _scan_reference
 
     def loss_scan(x, w):
-        hs, cs = _scan_reference(x, w)
+        hs, cs = _scan_reference(x, w, jnp.zeros((3, H), jnp.float32))
         return jnp.sum(jnp.sin(hs)) + jnp.sum(cs ** 2)
 
     gp = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
@@ -106,12 +106,95 @@ def test_lstm_op_use_pallas_attr():
     np.testing.assert_allclose(np.asarray(fused['Hidden'][0]),
                                np.asarray(base['Hidden'][0]),
                                rtol=1e-4, atol=1e-5)
-    # ragged rows: pallas path must NOT engage (lengths present)
+    # ragged rows: fused path (engaged via pallas_interpret off-TPU)
+    # must equal the masked scan
     lengths = np.array([5, 3, 4, 2], dtype='int64')
     ragged = run_op('lstm', {'Input': x, 'Weight': w, 'XLen': lengths},
-                    {'use_peepholes': False, 'use_pallas': True})
+                    {'use_peepholes': False, 'use_pallas': True,
+                     'pallas_interpret': True})
     plain = run_op('lstm', {'Input': x, 'Weight': w, 'XLen': lengths},
                    {'use_peepholes': False})
     np.testing.assert_allclose(np.asarray(ragged['Hidden'][0]),
                                np.asarray(plain['Hidden'][0]),
                                rtol=1e-5)
+
+
+def test_lstm_op_pallas_ragged_and_reverse_match_scan():
+    """Relaxed gate: the fused kernel handles ragged lengths (unmasked
+    run + outside zero-mask) and is_reverse (gather outside) with
+    numerics identical to the masked lax.scan path."""
+    B, T, H = 4, 9, 8
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = (rng.randn(H, 4 * H) * 0.5).astype('float32')
+    lens = np.array([9, 3, 7, 1], np.int32)
+    for rev in (False, True):
+        want = run_op('lstm', {'Input': x, 'Weight': w, 'XLen': lens},
+                      {'use_peepholes': False, 'is_reverse': rev})
+        got = run_op('lstm', {'Input': x, 'Weight': w, 'XLen': lens},
+                     {'use_peepholes': False, 'is_reverse': rev,
+                      'use_pallas': True, 'pallas_interpret': True})
+        for slot in ('Hidden', 'Cell'):
+            np.testing.assert_allclose(
+                np.asarray(got[slot][0]), np.asarray(want[slot][0]),
+                rtol=1e-4, atol=1e-5, err_msg='%s rev=%s' % (slot, rev))
+
+
+def test_gru_op_pallas_ragged_and_reverse_match_scan():
+    B, T, H = 4, 9, 8
+    x = rng.randn(B, T, 3 * H).astype('float32')
+    w = (rng.randn(H, 3 * H) * 0.5).astype('float32')
+    lens = np.array([2, 9, 5, 4], np.int32)
+    for rev in (False, True):
+        want = run_op('gru', {'Input': x, 'Weight': w, 'XLen': lens},
+                      {'is_reverse': rev})
+        got = run_op('gru', {'Input': x, 'Weight': w, 'XLen': lens},
+                     {'is_reverse': rev, 'use_pallas': True,
+                      'pallas_interpret': True})
+        np.testing.assert_allclose(
+            np.asarray(got['Hidden'][0]), np.asarray(want['Hidden'][0]),
+            rtol=1e-4, atol=1e-5, err_msg='rev=%s' % rev)
+
+
+def test_lstm_op_pallas_peepholes_match_scan():
+    """Peephole configs now ride the kernel too (pw = bias[4H:7H])."""
+    B, T, H = 4, 7, 8
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = (rng.randn(H, 4 * H) * 0.5).astype('float32')
+    bias = (rng.randn(1, 7 * H) * 0.1).astype('float32')
+    lens = np.array([7, 2, 5, 6], np.int32)
+    want = run_op('lstm', {'Input': x, 'Weight': w, 'Bias': bias,
+                           'XLen': lens}, {'use_peepholes': True})
+    got = run_op('lstm', {'Input': x, 'Weight': w, 'Bias': bias,
+                          'XLen': lens},
+                 {'use_peepholes': True, 'use_pallas': True,
+                  'pallas_interpret': True})
+    for slot in ('Hidden', 'Cell'):
+        np.testing.assert_allclose(
+            np.asarray(got[slot][0]), np.asarray(want[slot][0]),
+            rtol=1e-4, atol=1e-5, err_msg=slot)
+
+
+def test_lstm_bptt_kernel_peephole_grads_match_scan():
+    """The reverse-time BPTT kernel's dx/dW/dpw equal autodiff through
+    the identical scan (peepholes exercised)."""
+    B, T, H = 3, 6, 8
+    x = jnp.asarray(rng.randn(T, B, 4 * H), jnp.float32)
+    w = jnp.asarray(rng.randn(H, 4 * H) * 0.5, jnp.float32)
+    pw = jnp.asarray(rng.randn(3, H) * 0.3, jnp.float32)
+    ct_h = jnp.asarray(rng.randn(T, B, H), jnp.float32)
+    ct_c = jnp.asarray(rng.randn(T, B, H), jnp.float32)
+    from paddle_tpu.ops.pallas.lstm_cell import _scan_reference
+
+    def loss_p(x, w, pw):
+        hs, cs = lstm_scan(x, w, pw)
+        return jnp.sum(hs * ct_h) + jnp.sum(cs * ct_c)
+
+    def loss_s(x, w, pw):
+        hs, cs = _scan_reference(x, w, pw)
+        return jnp.sum(hs * ct_h) + jnp.sum(cs * ct_c)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(x, w, pw)
+    gs = jax.grad(loss_s, argnums=(0, 1, 2))(x, w, pw)
+    for a, b, name in zip(gp, gs, ('dx', 'dw', 'dpw')):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
