@@ -10,7 +10,7 @@ def main():
     import paddle_tpu as fluid
     from paddle_tpu import models
 
-    batch = 4096 if on_tpu() else 64
+    batch = 16384 if on_tpu() else 64
 
     def build():
         main_p, startup = fluid.Program(), fluid.Program()

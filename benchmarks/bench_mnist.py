@@ -8,7 +8,7 @@ def main():
     import paddle_tpu as fluid
     from paddle_tpu.models import mnist
 
-    batch = 512 if on_tpu() else 64
+    batch = 2048 if on_tpu() else 64
 
     def build():
         main_p, startup = fluid.Program(), fluid.Program()

@@ -10,18 +10,21 @@ def main():
     from paddle_tpu.models import vgg
 
     if on_tpu():
-        batch, hw, classes = 32, 224, 1000
+        batch, hw, classes = 128, 224, 1000
     else:
         batch, hw, classes = 4, 32, 10
 
     def build():
+        # bf16 activations, NHWC — the MXU recipe (same as bench.py)
         main_p, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_p, startup):
-            img = fluid.layers.data(name='img', shape=[3, hw, hw],
+            img = fluid.layers.data(name='img', shape=[hw, hw, 3],
                                     dtype='float32')
             label = fluid.layers.data(name='label', shape=[1],
                                       dtype='int64')
-            pred = vgg.vgg_imagenet(img, num_classes=classes)
+            x = fluid.layers.cast(x=img, dtype='bfloat16')
+            pred = vgg.vgg_imagenet(x, num_classes=classes,
+                                    layout='NHWC')
             cost = fluid.layers.mean(
                 x=fluid.layers.cross_entropy(input=pred, label=label))
             fluid.optimizer.MomentumOptimizer(0.01, 0.9).minimize(cost)
@@ -30,14 +33,14 @@ def main():
     rng = np.random.default_rng(0)
 
     def feed():
-        return {'img': rng.normal(size=(batch, 3, hw, hw)).astype(
+        return {'img': rng.normal(size=(batch, hw, hw, 3)).astype(
                     np.float32),
                 'label': rng.integers(0, classes, (batch, 1)).astype(
                     np.int32)}
 
     run_bench('vgg16_train_img_per_sec', batch, build, feed,
               steps=10 if on_tpu() else 3,
-              note='batch=%d hw=%d' % (batch, hw))
+              note='batch=%d hw=%d bf16 NHWC' % (batch, hw))
 
 
 if __name__ == '__main__':

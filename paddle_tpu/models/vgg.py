@@ -35,8 +35,12 @@ def vgg16_bn_drop(input, num_classes=10):
     return fluid.layers.fc(input=fc2, size=num_classes, act='softmax')
 
 
-def vgg_imagenet(input, num_classes=1000, depth=16):
-    """benchmark/paddle/image/vgg.py layout (plain convs, no BN)."""
+def vgg_imagenet(input, num_classes=1000, depth=16, layout='NCHW'):
+    """benchmark/paddle/image/vgg.py layout (plain convs, no BN).
+
+    layout='NHWC' keeps channels minor (the MXU-preferred layout); feed
+    bf16 input for the bf16 MXU path — the classifier head's final fc
+    runs fp32 so the softmax stays well-conditioned."""
     cfg = {16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}[depth]
 
     def conv_block(ipt, num_filter, groups):
@@ -48,7 +52,8 @@ def vgg_imagenet(input, num_classes=1000, depth=16):
             conv_filter_size=3,
             conv_act='relu',
             conv_with_batchnorm=False,
-            pool_type='max')
+            pool_type='max',
+            data_format=layout)
 
     out = input
     for num_filter, groups in zip([64, 128, 256, 512, 512], cfg):
@@ -57,4 +62,5 @@ def vgg_imagenet(input, num_classes=1000, depth=16):
     drop1 = fluid.layers.dropout(x=fc1, dropout_prob=0.5)
     fc2 = fluid.layers.fc(input=drop1, size=4096, act='relu')
     drop2 = fluid.layers.dropout(x=fc2, dropout_prob=0.5)
-    return fluid.layers.fc(input=drop2, size=num_classes, act='softmax')
+    head = fluid.layers.cast(x=drop2, dtype='float32')
+    return fluid.layers.fc(input=head, size=num_classes, act='softmax')

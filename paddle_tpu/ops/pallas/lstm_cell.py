@@ -162,13 +162,17 @@ def _lstm_forward(x_tm, w, pw, with_gates):
     interpret = jax.default_backend() != 'tpu'
     kernel = functools.partial(_lstm_kernel, hidden=hidden,
                                with_gates=with_gates)
+    # the grad path keeps h/c residuals f32 so the BPTT replay sees the
+    # exact forward carry (bf16 callers would otherwise replay rounded
+    # snapshots); the primal path emits the caller's dtype directly
+    hc_dtype = jnp.float32 if with_gates else x_tm.dtype
     out_specs = [
         pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
         pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
-        jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype),
+        jax.ShapeDtypeStruct((t, b, hidden), hc_dtype),
+        jax.ShapeDtypeStruct((t, b, hidden), hc_dtype),
     ]
     if with_gates:
         out_specs.append(pl.BlockSpec((1, b, four_h),
@@ -243,10 +247,11 @@ def _lstm_scan_core(x_tm, w, pw):
 
 
 def _fwd(x_tm, w, pw):
-    hs, cs, gates = _lstm_forward(x_tm, w, pw, with_gates=True)
+    hs, cs, gates = _lstm_forward(x_tm, w, pw, with_gates=True)  # f32
     # zero-size token carries x's dtype (residuals must be jax types)
     x_tok = jnp.empty((0,), x_tm.dtype)
-    return (hs, cs), (x_tok, w, pw, hs, cs, gates)
+    return (hs.astype(x_tm.dtype), cs.astype(x_tm.dtype)), \
+        (x_tok, w, pw, hs, cs, gates)
 
 
 def _bwd(res, cts):
@@ -367,8 +372,9 @@ def _gru_forward(x_tm, w, with_gates):
     interpret = jax.default_backend() != 'tpu'
     kernel = functools.partial(_gru_kernel, hidden=hidden,
                                with_gates=with_gates)
+    h_dtype = jnp.float32 if with_gates else x_tm.dtype  # see LSTM note
     out_specs = [pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0))]
-    out_shape = [jax.ShapeDtypeStruct((t, b, hidden), x_tm.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((t, b, hidden), h_dtype)]
     if with_gates:
         out_specs.append(pl.BlockSpec((1, b, three_h),
                                       lambda i: (i, 0, 0)))
@@ -433,9 +439,9 @@ def gru_scan(x_tm, w):
 
 
 def _gru_fwd(x_tm, w):
-    hs, gates = _gru_forward(x_tm, w, with_gates=True)
+    hs, gates = _gru_forward(x_tm, w, with_gates=True)  # hs f32
     x_tok = jnp.empty((0,), x_tm.dtype)
-    return hs, (x_tok, w, hs, gates)
+    return hs.astype(x_tm.dtype), (x_tok, w, hs, gates)
 
 
 def _gru_bwd(res, ct):

@@ -40,6 +40,33 @@ def _maybe_reverse(xf, lengths, is_reverse):
     return jnp.take_along_axis(xf, rev_idx[..., None], axis=1), rev_idx
 
 
+def _unreverse_and_mask(seqs, rev_idx, lengths, t):
+    """Shared RNN output epilogue: undo _maybe_reverse's gather and zero
+    positions >= length.  seqs: [B, T, H] arrays; returns the list."""
+    mask = None
+    if lengths is not None:
+        mask = (jnp.arange(t)[None, :] <
+                lengths.astype(jnp.int32).reshape(-1)[:, None])[..., None]
+    out = []
+    for v in seqs:
+        if rev_idx is not None:
+            v = jnp.take_along_axis(v, rev_idx[..., None], axis=1)
+        if mask is not None:
+            v = jnp.where(mask, v, 0.0)
+        out.append(v)
+    return out
+
+
+def _pallas_rnn_fits_vmem(batch, hidden, gate_width):
+    """The BPTT kernel keeps the weight block AND an equally-sized f32
+    dW accumulator resident in VMEM for the whole grid, plus a few
+    [B, gate_width] tiles; past ~12MB Mosaic's scratch allocation fails,
+    so larger configs fall back to the lax.scan path."""
+    resident = 2 * hidden * gate_width * 4
+    tiles = 8 * batch * gate_width * 4
+    return resident + tiles <= 12 * 1024 * 1024
+
+
 @register_op('lstm')
 def _lstm(ctx, ins, attrs):
     """Dynamic LSTM over a padded batch (operators/lstm_op.cc).  Input is
@@ -67,6 +94,7 @@ def _lstm(ctx, ins, attrs):
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('cell_activation', 'tanh') == 'tanh' and \
             attrs.get('candidate_activation', 'tanh') == 'tanh' and \
+            _pallas_rnn_fits_vmem(b, h, fourh) and \
             (jax.default_backend() == 'tpu' or
              attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.py): carry lives
@@ -85,16 +113,9 @@ def _lstm(ctx, ins, attrs):
               .reshape(3, h) if use_peepholes else None)
         # kernel gate order (i, f, cand, o) == this op's (i, f, c, o)
         hs, cs = lstm_scan(jnp.swapaxes(xin, 0, 1), w, pw)
-        hs = jnp.swapaxes(hs, 0, 1)
-        cs = jnp.swapaxes(cs, 0, 1)
-        if rev_idx is not None:
-            hs = jnp.take_along_axis(hs, rev_idx[..., None], axis=1)
-            cs = jnp.take_along_axis(cs, rev_idx[..., None], axis=1)
-        if lengths is not None:
-            mask = (jnp.arange(t)[None, :] <
-                    lengths.astype(jnp.int32).reshape(-1)[:, None])[..., None]
-            hs = jnp.where(mask, hs, 0.0)
-            cs = jnp.where(mask, cs, 0.0)
+        hs, cs = _unreverse_and_mask(
+            [jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)],
+            rev_idx, lengths, t)
         return {'Hidden': [hs.astype(x.dtype)],
                 'Cell': [cs.astype(x.dtype)]}
     if lengths is None:
@@ -140,15 +161,10 @@ def _lstm(ctx, ins, attrs):
     (_, _), (hs, cs) = jax.lax.scan(
         step, (h_prev, c_prev),
         (jnp.swapaxes(xf, 0, 1), jnp.arange(t)))
-    hs = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
-    cs = jnp.swapaxes(cs, 0, 1)
-    if is_reverse:
-        hs = jnp.take_along_axis(hs, rev_idx[..., None], axis=1)
-        cs = jnp.take_along_axis(cs, rev_idx[..., None], axis=1)
-    mask = (jnp.arange(t)[None, :] < lengths[:, None])[..., None]
-    hs = jnp.where(mask, hs, 0.0).astype(x.dtype)
-    cs = jnp.where(mask, cs, 0.0).astype(x.dtype)
-    return {'Hidden': [hs], 'Cell': [cs]}
+    hs, cs = _unreverse_and_mask(
+        [jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)],
+        rev_idx if is_reverse else None, lengths, t)
+    return {'Hidden': [hs.astype(x.dtype)], 'Cell': [cs.astype(x.dtype)]}
 
 
 @register_op('lstm_unit')
@@ -187,6 +203,7 @@ def _gru(ctx, ins, attrs):
     if attrs.get('use_pallas') and h0 is None and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('activation', 'tanh') == 'tanh' and \
+            _pallas_rnn_fits_vmem(b, h, threeh) and \
             (jax.default_backend() == 'tpu' or
              attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.gru_scan); ragged
@@ -195,12 +212,7 @@ def _gru(ctx, ins, attrs):
         xin, rev_idx = _maybe_reverse(xf, lengths,
                                       attrs.get('is_reverse', False))
         hs = jnp.swapaxes(gru_scan(jnp.swapaxes(xin, 0, 1), w), 0, 1)
-        if rev_idx is not None:
-            hs = jnp.take_along_axis(hs, rev_idx[..., None], axis=1)
-        if lengths is not None:
-            mask = (jnp.arange(t)[None, :] <
-                    lengths.astype(jnp.int32).reshape(-1)[:, None])[..., None]
-            hs = jnp.where(mask, hs, 0.0)
+        hs, = _unreverse_and_mask([hs], rev_idx, lengths, t)
         return {'Hidden': [hs.astype(x.dtype)]}
 
     if lengths is None:
@@ -230,12 +242,9 @@ def _gru(ctx, ins, attrs):
 
     _, hs = jax.lax.scan(step, h_prev,
                          (jnp.swapaxes(xf, 0, 1), jnp.arange(t)))
-    hs = jnp.swapaxes(hs, 0, 1)
-    if is_reverse:
-        hs = jnp.take_along_axis(hs, rev_idx[..., None], axis=1)
-    mask = (jnp.arange(t)[None, :] < lengths[:, None])[..., None]
-    hs = jnp.where(mask, hs, 0.0).astype(x.dtype)
-    return {'Hidden': [hs]}
+    hs, = _unreverse_and_mask([jnp.swapaxes(hs, 0, 1)],
+                              rev_idx if is_reverse else None, lengths, t)
+    return {'Hidden': [hs.astype(x.dtype)]}
 
 
 @register_op('gru_unit')
