@@ -1,0 +1,39 @@
+"""Linear regression on uci_housing (reference book chapter 1:
+test_fit_a_line.py)."""
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+
+
+def main():
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=y_predict, label=y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(cost)
+
+    place = fluid.default_place()  # TPU when attached
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    reader = fluid.batch(
+        fluid.reader.shuffle(datasets.uci_housing.train(), buf_size=500),
+        batch_size=20)
+
+    for epoch in range(10):
+        costs = [float(np.ravel(exe.run(feed=feeder.feed(b),
+                                        fetch_list=[cost])[0])[0])
+                 for b in reader()]
+        print('epoch %d  avg cost %.4f' % (epoch, np.mean(costs)))
+
+
+if __name__ == '__main__':
+    main()

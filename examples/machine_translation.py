@@ -1,0 +1,60 @@
+"""seq2seq + attention NMT: train a few steps, then beam-search decode
+(reference book chapter 8: test_machine_translation.py)."""
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere
+
+import paddle_tpu as fluid
+from paddle_tpu.models import seq2seq
+
+
+def main():
+    dict_size = 300
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        src, trg, label, pred, avg_cost = seq2seq.build(
+            dict_size=dict_size, word_dim=32, hidden_dim=64)
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
+
+    place = fluid.default_place()  # TPU when attached
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    T, B = 12, 16
+    ln = np.full((B,), T, np.int32)
+
+    def batch():
+        mk = lambda: (rng.integers(1, dict_size, (B, T, 1)).astype(
+            np.int32), ln)
+        return {'src_word_id': mk(), 'target_language_word': mk(),
+                'target_language_next_word': mk()}
+
+    for step in range(20):
+        c, = exe.run(main_prog, feed=batch(), fetch_list=[avg_cost])
+        if step % 5 == 0:
+            print('step %d  cost %.4f' % (step, float(np.ravel(c)[0])))
+
+    # beam-search generation over the trained weights
+    decode_prog = fluid.Program()
+    with fluid.program_guard(decode_prog, fluid.Program()):
+        src_d = fluid.layers.data(name='src_word_id', shape=[1],
+                                  dtype='int64', lod_level=1)
+        ids, scores = seq2seq.decode(src_d, dict_size=dict_size,
+                                     word_dim=32, hidden_dim=64,
+                                     beam_size=4, max_len=16)
+    src_ids = (rng.integers(1, dict_size, (4, T, 1)).astype(np.int32),
+               np.full((4,), T, np.int32))
+    out_ids, out_scores = exe.run(
+        decode_prog, feed={'src_word_id': src_ids},
+        fetch_list=[ids, scores])
+    print('decoded ids shape %s  best score %.3f' %
+          (np.asarray(out_ids).shape, float(np.max(out_scores))))
+
+
+if __name__ == '__main__':
+    main()

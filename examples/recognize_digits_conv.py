@@ -1,0 +1,49 @@
+"""MNIST convnet (reference book chapter 2:
+test_recognize_digits_conv.py)."""
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+
+
+def main():
+    img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                            dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    conv1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act='relu')
+    conv2 = fluid.nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act='relu')
+    predict = fluid.layers.fc(input=conv2, size=10, act='softmax')
+    cost = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=predict, label=label))
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(cost)
+
+    place = fluid.default_place()  # TPU when attached
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    reader = fluid.batch(
+        fluid.reader.shuffle(datasets.mnist.train(), buf_size=500),
+        batch_size=64)
+
+    for epoch in range(3):
+        accs = []
+        for batch in reader():
+            _, a = exe.run(feed=feeder.feed(batch),
+                           fetch_list=[cost, acc])
+            accs.append(float(np.ravel(a)[0]))
+        print('epoch %d  train acc %.3f' % (epoch, np.mean(accs[-50:])))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,42 @@
+"""IMDB sentiment with a dynamic LSTM (reference book chapter 6:
+test_understand_sentiment_dynamic_lstm.py).  On TPU the LSTM time loop
+runs the fused Pallas kernel automatically."""
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+from paddle_tpu.models import sentiment
+
+
+def main():
+    word_dict = datasets.imdb.word_dict()
+    data, label, cost, acc, _pred = sentiment.build(
+        input_dim=len(word_dict), net='dynamic_lstm')
+    fluid.optimizer.AdamOptimizer(learning_rate=2e-3).minimize(cost)
+
+    place = fluid.default_place()  # TPU when attached
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[data, label])
+    reader = fluid.batch(
+        fluid.reader.shuffle(datasets.imdb.train(word_dict),
+                             buf_size=1000), batch_size=32,
+        drop_last=True)
+
+    for epoch in range(2):
+        costs = []
+        for batch in reader():
+            c, _ = exe.run(feed=feeder.feed(batch),
+                           fetch_list=[cost, acc])
+            costs.append(float(np.ravel(c)[0]))
+        print('epoch %d  avg cost %.4f' % (epoch, np.mean(costs)))
+
+
+if __name__ == '__main__':
+    main()

@@ -23,7 +23,7 @@ from .core import (Block, CPUPlace, CUDAPlace, LoDTensor, Operator,  # noqa
                    create_lod_tensor, default_main_program,
                    default_startup_program, global_scope, grad_var_name,
                    name_scope, program_guard, scope_guard,
-                   switch_main_program, switch_startup_program, unique_name)
+                   switch_main_program, switch_startup_program, unique_name, default_place)
 from .core.executor import Executor
 from .core import backward
 from .core.backward import append_backward, calc_gradient  # noqa: F401
@@ -70,7 +70,7 @@ __all__ = [
     'SimpleDistributeTranspiler',
     'Executor', 'Program', 'Block', 'Operator', 'Variable', 'Parameter',
     'Scope', 'LoDTensor', 'Tensor', 'ParamAttr', 'DataFeeder',
-    'CPUPlace', 'CUDAPlace', 'TPUPlace', 'XLAPlace',
+    'CPUPlace', 'CUDAPlace', 'TPUPlace', 'XLAPlace', 'default_place',
     'default_main_program', 'default_startup_program', 'program_guard',
     'scope_guard', 'global_scope', 'append_backward', 'unique_name',
 ]

@@ -31,12 +31,18 @@ class ExecutionContext(object):
     access to the interpreter for ops that carry sub-blocks, and the
     enclosing program/block."""
 
-    def __init__(self, program, block, rng_key, uid_prefix=0):
+    def __init__(self, program, block, rng_key, uid_prefix=0,
+                 backend=None):
         self.program = program
         self.block = block
         self.rng_key = rng_key
         self.uid_prefix = uid_prefix
         self.op_index = 0
+        # platform the enclosing jit targets ('tpu'/'cpu'): ops that pick
+        # between a Pallas kernel and a lax fallback must key off THIS,
+        # not jax.default_backend() — a CPUPlace run on a TPU-attached
+        # host would otherwise compile Pallas kernels for CPU
+        self.backend = backend or jax.default_backend()
 
     def rng(self, extra=0):
         """Deterministic per-op PRNG key: stable under the autodiff replay
@@ -50,7 +56,8 @@ class ExecutionContext(object):
 
     def sub_context(self, block):
         sub = ExecutionContext(self.program, block, self.rng_key,
-                               self.uid_prefix + 1000)
+                               self.uid_prefix + 1000,
+                               backend=self.backend)
         return sub
 
     def run_block(self, block_idx, env):
@@ -432,13 +439,15 @@ class Executor(object):
                     "and is not fed" % n)
 
         prog = program
+        backend = self.place.jax_device().platform
 
         def step_fn(feed_vals, state_rw, state_ro, rng_key):
             env = {}
             env.update(state_ro)
             env.update(state_rw)
             env.update(feed_vals)
-            ctx = ExecutionContext(prog, prog.global_block(), rng_key)
+            ctx = ExecutionContext(prog, prog.global_block(), rng_key,
+                                   backend=backend)
             _run_ops(prog.global_block().ops, env, ctx)
             fetches = []
             for n in fetch_names:

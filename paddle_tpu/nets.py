@@ -82,7 +82,8 @@ def glu(input, dim=-1):
 
 def scaled_dot_product_attention(queries, keys, values,
                                  num_heads=1, dropout_rate=0.0,
-                                 use_flash=False, causal=False):
+                                 use_flash=False, causal=False,
+                                 pallas_interpret=False):
     """Multi-head scaled dot-product attention (fluid/nets.py parity).
     Inputs are [batch, seq, d]; runs as MXU batched matmuls.
 
@@ -111,7 +112,8 @@ def scaled_dot_product_attention(queries, keys, values,
             type='flash_attention',
             inputs={'Q': [q4], 'K': [k4], 'V': [v4]},
             outputs={'Out': [ctx_out]},
-            attrs={'causal': bool(causal)})
+            attrs={'causal': bool(causal),
+                   'pallas_interpret': bool(pallas_interpret)})
         return layers.reshape(
             x=ctx_out, shape=[queries.shape[0] if queries.shape[0] > 0
                               else -1, queries.shape[1],

@@ -3,24 +3,54 @@
 Reference parity: the reference composes attention from matmul+softmax ops
 (fluid nets.py scaled_dot_product_attention); this op is the TPU-native
 fused form — ops/pallas/flash_attention.py online-softmax kernel, O(block)
-on-chip memory instead of a [Tq, Tk] HBM score matrix.
+on-chip memory instead of a [Tq, Tk] HBM score matrix.  When the
+executor's place is NOT a TPU (ctx.backend), the op computes the same
+math densely in jnp — a CPUPlace run on a TPU-attached host must not
+compile Pallas for CPU, and interpret mode would be orders slower.
 """
+import jax
+import jax.numpy as jnp
+
 from ..core.registry import register_op
 from .common import first, out
 
 
+def _dense_attention(q, k, v, causal, scale):
+    squeeze = q.ndim == 3
+    if squeeze:
+        q, k, v = (x[:, :, None, :] for x in (q, k, v))
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = s.shape[2], s.shape[3]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32))
+    return o[:, :, 0, :] if squeeze else o
+
+
 @register_op('flash_attention')
 def _flash_attention(ctx, ins, attrs):
-    # lazy: jax.experimental.pallas loads only when the op actually runs,
-    # keeping `import paddle_tpu` free of the pallas extras
-    from .pallas import flash_attention
     q = first(ins, 'Q')  # [B, T, H, D] or [B, T, D]
     k = first(ins, 'K')
     v = first(ins, 'V')
+    causal = attrs.get('causal', False)
+    scale = attrs.get('scale', None)
+    backend = getattr(ctx, 'backend', jax.default_backend())
+    if backend != 'tpu' and not attrs.get('pallas_interpret', False):
+        return out(_dense_attention(q, k, v, causal, scale)
+                   .astype(q.dtype))
+    # lazy: jax.experimental.pallas loads only when the op actually runs,
+    # keeping `import paddle_tpu` free of the pallas extras
+    from .pallas import flash_attention
     y = flash_attention(
         q, k, v,
-        causal=attrs.get('causal', False),
-        scale=attrs.get('scale', None),
+        causal=causal,
+        scale=scale,
         block_q=attrs.get('block_q', 512),
-        block_k=attrs.get('block_k', 512))
+        block_k=attrs.get('block_k', 512),
+        interpret=backend != 'tpu')
     return out(y.astype(q.dtype))

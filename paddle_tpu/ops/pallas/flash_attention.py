@@ -425,38 +425,36 @@ def _fa_backward_pallas(causal, scale, block_q, block_k, res, do,
     return dq[:, :tq], dk[:, :tk], dv[:, :tk]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _flash_with_lse(q, k, v, q_off, k_off, causal, scale, block_q,
-                    block_k):
+                    block_k, interpret):
     """[BH, T, D] kernel entry returning (o, lse); differentiable —
     the backward folds both cotangents into one flash recompute.
     q_off/k_off are traced int32 scalars shifting the causal mask."""
-    interpret = jax.default_backend() != 'tpu'
     return _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
                               interpret, q_off, k_off)
 
 
-def _flash_fwd(q, k, v, q_off, k_off, causal, scale, block_q, block_k):
-    interpret = jax.default_backend() != 'tpu'
+def _flash_fwd(q, k, v, q_off, k_off, causal, scale, block_q, block_k,
+               interpret):
     o, lse = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
                                 interpret, q_off, k_off)
     return (o, lse), (q, k, v, q_off, k_off, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, cts):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, cts):
     # env knobs are read at TRACE time (the vjp is cached under jit):
     # toggling them mid-process needs jax.clear_caches().
     # PADDLE_TPU_FLASH_BWD_SCAN forces the jax-scan path on TPU (A/B
     # numerics); PADDLE_TPU_FLASH_BWD_PALLAS forces the Pallas kernels
     # (interpret mode) off-TPU.
     do, dlse = cts
-    on_tpu = jax.default_backend() == 'tpu'
     force_scan = _env_on('PADDLE_TPU_FLASH_BWD_SCAN')
-    if (on_tpu and not force_scan) or \
+    if (not interpret and not force_scan) or \
             _env_on('PADDLE_TPU_FLASH_BWD_PALLAS'):
         dq, dk, dv = _fa_backward_pallas(causal, scale, block_q, block_k,
                                          res, do, dlse,
-                                         interpret=not on_tpu)
+                                         interpret=interpret)
     else:  # CPU: the jax-scan recompute (fast under interpret-free jit)
         dq, dk, dv = _fa_backward(causal, scale, block_k, res, do, dlse)
     f0 = _np.zeros((), jax.dtypes.float0)  # int operands: zero cotangent
@@ -480,7 +478,8 @@ def _to_bhtd(q, k, v):
 
 
 def attention_with_lse(q, k, v, causal=False, scale=None, block_q=512,
-                       block_k=512, q_offset=0, k_offset=0):
+                       block_k=512, q_offset=0, k_offset=0,
+                       interpret=None):
     """Fused attention returning (o, lse) for online-softmax merging
     (ring attention's local blocks).  q/k/v [B, T, H, D] -> o same shape,
     lse [B, H, T].  Differentiable.  q_offset/k_offset (traced int ok)
@@ -491,8 +490,11 @@ def attention_with_lse(q, k, v, causal=False, scale=None, block_q=512,
     qf, kf, vf, restore = _to_bhtd(q, k, v)
     qo = jnp.asarray(q_offset, jnp.int32)
     ko = jnp.asarray(k_offset, jnp.int32)
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
     o, lse = _flash_with_lse(qf, kf, vf, qo, ko, bool(causal),
-                             float(scale), int(block_q), int(block_k))
+                             float(scale), int(block_q), int(block_k),
+                             bool(interpret))
     if restore is None:
         return o, lse
     b, h, tq, d = restore
@@ -501,7 +503,7 @@ def attention_with_lse(q, k, v, causal=False, scale=None, block_q=512,
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512):
+                    block_k=512, interpret=None):
     """Fused attention over [B, T, H, D] (or [BH, T, D]) tensors.
 
     Returns softmax(q k^T * scale [+ causal mask]) v with O(block) live
@@ -517,5 +519,6 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     else:
         q4, k4, v4 = q, k, v
     o, _lse = attention_with_lse(q4, k4, v4, causal=causal, scale=scale,
-                                 block_q=block_q, block_k=block_k)
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
     return o[:, :, 0, :] if squeeze else o

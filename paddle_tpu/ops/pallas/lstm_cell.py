@@ -145,21 +145,25 @@ def _scan_reference(x_tm, w, pw):
     return hs.astype(x_tm.dtype), cs.astype(x_tm.dtype)
 
 
-def lstm_scan(x_tm, w, pw=None):
+def lstm_scan(x_tm, w, pw=None, interpret=None):
     """Fused LSTM over time-major gates x_tm [T, B, 4H], recurrent weight
     w [H, 4H], optional peephole weights pw [3, H] (w_ic, w_fc, w_oc);
-    zero initial state.  Returns (hs, cs) [T, B, H] each."""
+    zero initial state.  Returns (hs, cs) [T, B, H] each.
+    interpret=None auto-selects off the default backend; executor ops
+    pass it explicitly so a CPUPlace run on a TPU-attached host doesn't
+    compile Mosaic for CPU."""
     if pw is None:
         pw = jnp.zeros((3, w.shape[0]), jnp.float32)
-    return _lstm_scan_core(x_tm, w, pw)
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    return _lstm_scan_core(x_tm, w, pw, bool(interpret))
 
 
-def _lstm_forward(x_tm, w, pw, with_gates):
+def _lstm_forward(x_tm, w, pw, with_gates, interpret):
     """with_gates=True also emits the f32 post-activation gates the BPTT
     kernel replays; the primal (no-grad) path skips that HBM write."""
     t, b, four_h = x_tm.shape
     hidden = four_h // 4
-    interpret = jax.default_backend() != 'tpu'
     kernel = functools.partial(_lstm_kernel, hidden=hidden,
                                with_gates=with_gates)
     # the grad path keeps h/c residuals f32 so the BPTT replay sees the
@@ -197,10 +201,9 @@ def _lstm_forward(x_tm, w, pw, with_gates):
     )(x_tm, w, pw)
 
 
-def _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c):
+def _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c, interpret):
     t, b, four_h = gates.shape
     hidden = four_h // 4
-    interpret = jax.default_backend() != 'tpu'
     zrow = jnp.zeros((1, b, hidden), hs.dtype)
     h_prev = jnp.concatenate([zrow, hs[:-1]], axis=0)
     c_prev = jnp.concatenate([zrow, cs[:-1]], axis=0)
@@ -240,26 +243,29 @@ def _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c):
     return dx, dw, dpw
 
 
-@jax.custom_vjp
-def _lstm_scan_core(x_tm, w, pw):
-    hs, cs = _lstm_forward(x_tm, w, pw, with_gates=False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _lstm_scan_core(x_tm, w, pw, interpret):
+    hs, cs = _lstm_forward(x_tm, w, pw, with_gates=False,
+                           interpret=interpret)
     return hs, cs
 
 
-def _fwd(x_tm, w, pw):
-    hs, cs, gates = _lstm_forward(x_tm, w, pw, with_gates=True)  # f32
+def _fwd(x_tm, w, pw, interpret):
+    hs, cs, gates = _lstm_forward(x_tm, w, pw, with_gates=True,
+                                  interpret=interpret)  # h/c f32
     # zero-size token carries x's dtype (residuals must be jax types)
     x_tok = jnp.empty((0,), x_tm.dtype)
     return (hs.astype(x_tm.dtype), cs.astype(x_tm.dtype)), \
         (x_tok, w, pw, hs, cs, gates)
 
 
-def _bwd(res, cts):
+def _bwd(interpret, res, cts):
     # hand-written reverse-time kernel over the saved forward state —
     # no recompute pass (cf. the scan path, which re-runs the forward)
     x_tok, w, pw, hs, cs, gates = res
     ct_h, ct_c = cts
-    dx, dw, dpw = _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c)
+    dx, dw, dpw = _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c,
+                                 interpret)
     return (dx.astype(x_tok.dtype), dw.astype(w.dtype),
             dpw.astype(pw.dtype))
 
@@ -366,10 +372,9 @@ def _gru_scan_reference(x_tm, w):
     return hs.astype(x_tm.dtype)
 
 
-def _gru_forward(x_tm, w, with_gates):
+def _gru_forward(x_tm, w, with_gates, interpret):
     t, b, three_h = x_tm.shape
     hidden = three_h // 3
-    interpret = jax.default_backend() != 'tpu'
     kernel = functools.partial(_gru_kernel, hidden=hidden,
                                with_gates=with_gates)
     h_dtype = jnp.float32 if with_gates else x_tm.dtype  # see LSTM note
@@ -395,10 +400,9 @@ def _gru_forward(x_tm, w, with_gates):
     return out if with_gates else (out[0], None)
 
 
-def _gru_backward(w, hs, gates, ct_h):
+def _gru_backward(w, hs, gates, ct_h, interpret):
     t, b, three_h = gates.shape
     hidden = three_h // 3
-    interpret = jax.default_backend() != 'tpu'
     zrow = jnp.zeros((1, b, hidden), hs.dtype)
     h_prev = jnp.concatenate([zrow, hs[:-1]], axis=0)
     rev = lambda i: (t - 1 - i, 0, 0)
@@ -429,26 +433,33 @@ def _gru_backward(w, hs, gates, ct_h):
     return dx, dw
 
 
-@jax.custom_vjp
-def gru_scan(x_tm, w):
+def gru_scan(x_tm, w, interpret=None):
     """Fused GRU over time-major gates x_tm [T, B, 3H], recurrent weight
     w [H, 3H] ([:, :2H] update/reset, [:, 2H:] candidate); zero initial
-    state.  Returns hs [T, B, H]."""
-    hs, _ = _gru_forward(x_tm, w, with_gates=False)
+    state.  Returns hs [T, B, H].  interpret: see lstm_scan."""
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    return _gru_scan_core(x_tm, w, bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _gru_scan_core(x_tm, w, interpret):
+    hs, _ = _gru_forward(x_tm, w, with_gates=False, interpret=interpret)
     return hs
 
 
-def _gru_fwd(x_tm, w):
-    hs, gates = _gru_forward(x_tm, w, with_gates=True)  # hs f32
+def _gru_fwd(x_tm, w, interpret):
+    hs, gates = _gru_forward(x_tm, w, with_gates=True,
+                             interpret=interpret)  # hs f32
     x_tok = jnp.empty((0,), x_tm.dtype)
     return hs.astype(x_tm.dtype), (x_tok, w, hs, gates)
 
 
-def _gru_bwd(res, ct):
+def _gru_bwd(interpret, res, ct):
     # reverse-time BPTT kernel over the saved forward state
     x_tok, w, hs, gates = res
-    dx, dw = _gru_backward(w, hs, gates, ct)
+    dx, dw = _gru_backward(w, hs, gates, ct, interpret)
     return dx.astype(x_tok.dtype), dw.astype(w.dtype)
 
 
-gru_scan.defvjp(_gru_fwd, _gru_bwd)
+_gru_scan_core.defvjp(_gru_fwd, _gru_bwd)

@@ -90,13 +90,13 @@ def _lstm(ctx, ins, attrs):
     if bias is not None:
         xf = xf + bias.astype(jnp.float32)[..., :4 * h].reshape(1, 1, -1)
 
+    backend = getattr(ctx, 'backend', None) or jax.default_backend()
     if attrs.get('use_pallas') and h0 is None and c0 is None and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('cell_activation', 'tanh') == 'tanh' and \
             attrs.get('candidate_activation', 'tanh') == 'tanh' and \
             _pallas_rnn_fits_vmem(b, h, fourh) and \
-            (jax.default_backend() == 'tpu' or
-             attrs.get('pallas_interpret', False)):
+            (backend == 'tpu' or attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.py): carry lives
         # in VMEM across grid steps; backward is the reverse-time BPTT
         # kernel.  TPU-only (interpret mode would unroll all T steps);
@@ -112,7 +112,8 @@ def _lstm(ctx, ins, attrs):
         pw = (bias.astype(jnp.float32).reshape(-1)[4 * h:7 * h]
               .reshape(3, h) if use_peepholes else None)
         # kernel gate order (i, f, cand, o) == this op's (i, f, c, o)
-        hs, cs = lstm_scan(jnp.swapaxes(xin, 0, 1), w, pw)
+        hs, cs = lstm_scan(jnp.swapaxes(xin, 0, 1), w, pw,
+                           interpret=backend != 'tpu')
         hs, cs = _unreverse_and_mask(
             [jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)],
             rev_idx, lengths, t)
@@ -200,18 +201,19 @@ def _gru(ctx, ins, attrs):
     if bias is not None:
         xf = xf + bias.astype(jnp.float32).reshape(1, 1, -1)
 
+    backend = getattr(ctx, 'backend', None) or jax.default_backend()
     if attrs.get('use_pallas') and h0 is None and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('activation', 'tanh') == 'tanh' and \
             _pallas_rnn_fits_vmem(b, h, threeh) and \
-            (jax.default_backend() == 'tpu' or
-             attrs.get('pallas_interpret', False)):
+            (backend == 'tpu' or attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.gru_scan); ragged
         # batches run unmasked + zero-mask outside (see the lstm branch)
         from .pallas.lstm_cell import gru_scan
         xin, rev_idx = _maybe_reverse(xf, lengths,
                                       attrs.get('is_reverse', False))
-        hs = jnp.swapaxes(gru_scan(jnp.swapaxes(xin, 0, 1), w), 0, 1)
+        hs = jnp.swapaxes(gru_scan(jnp.swapaxes(xin, 0, 1), w,
+                                   interpret=backend != 'tpu'), 0, 1)
         hs, = _unreverse_and_mask([hs], rev_idx, lengths, t)
         return {'Hidden': [hs.astype(x.dtype)]}
 

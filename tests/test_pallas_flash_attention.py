@@ -76,7 +76,8 @@ def test_nets_attention_flash_matches_matmul_path():
         dense = fluid.nets.scaled_dot_product_attention(
             q, k, v, num_heads=heads)
         flash = fluid.nets.scaled_dot_product_attention(
-            q, k, v, num_heads=heads, use_flash=True)
+            q, k, v, num_heads=heads, use_flash=True,
+            pallas_interpret=True)  # exercise the KERNEL path on CPU CI
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     feed = {n: rng.randn(b, t, dm).astype('float32') for n in 'qkv'}
@@ -136,3 +137,28 @@ def test_pallas_backward_kernels_match_scan(causal, monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-5,
                                    err_msg='d' + name)
+
+
+def test_nets_attention_dense_fallback_matches_matmul_path():
+    """Without pallas_interpret on a non-TPU place the op takes the
+    _dense_attention fallback — it must equal the layer-composed path
+    (this is what every CPU/GPU use_flash=True run executes)."""
+    import paddle_tpu as fluid
+
+    b, t, dm, heads = 2, 48, 32, 4
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data(name='q', shape=[t, dm], dtype='float32')
+        k = fluid.layers.data(name='k', shape=[t, dm], dtype='float32')
+        v = fluid.layers.data(name='v', shape=[t, dm], dtype='float32')
+        dense = fluid.nets.scaled_dot_product_attention(
+            q, k, v, num_heads=heads)
+        flash = fluid.nets.scaled_dot_product_attention(
+            q, k, v, num_heads=heads, use_flash=True)  # dense fallback
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {n: rng.randn(b, t, dm).astype('float32') for n in 'qkv'}
+    o1, o2 = exe.run(main, feed=feed, fetch_list=[dense, flash])
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(o1),
+                               rtol=2e-4, atol=2e-4)
