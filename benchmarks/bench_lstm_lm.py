@@ -18,7 +18,8 @@ def main():
     def build():
         main_p, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_p, startup):
-            src, target, avg_cost = rnn_lm.build(vocab_size=vocab)
+            src, target, avg_cost = rnn_lm.build(vocab_size=vocab,
+                                                 dtype='bfloat16')
             fluid.optimizer.AdagradOptimizer(0.1).minimize(avg_cost)
         return main_p, startup, avg_cost
 

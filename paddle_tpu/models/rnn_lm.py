@@ -8,23 +8,34 @@ import paddle_tpu as fluid
 __all__ = ['build']
 
 
-def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2):
+def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2,
+          dtype='float32'):
     """Returns (src, target, avg_cost).  src/target are token-id sequences
-    (lod_level=1); target is src shifted by one."""
+    (lod_level=1); target is src shifted by one.
+
+    dtype='bfloat16' runs the projection/vocab-head matmuls in bf16 with
+    fp32 master weights (layers/nn.py fc keeps p_dtype fp32); the LSTM
+    recurrence and the softmax head stay fp32."""
     src = fluid.layers.data(name='src', shape=[1], dtype='int64',
                             lod_level=1)
     target = fluid.layers.data(name='target', shape=[1], dtype='int64',
                                lod_level=1)
     emb = fluid.layers.embedding(input=src, size=[vocab_size, emb_dim])
     x = emb
+    if dtype == 'bfloat16':
+        x = fluid.layers.cast(x=x, dtype='bfloat16')
     for i in range(num_layers):
         fc = fluid.layers.fc(input=x, size=hidden_dim * 4,
                              num_flatten_dims=2)
         h, _ = fluid.layers.dynamic_lstm(input=fc, size=hidden_dim * 4)
         x = h
+    # vocab-head matmul in the activation dtype; softmax in fp32
     logits = fluid.layers.fc(input=x, size=vocab_size, num_flatten_dims=2,
-                             act='softmax')
-    cost = fluid.layers.cross_entropy(input=logits, label=target,
+                             act=None)
+    if dtype == 'bfloat16':
+        logits = fluid.layers.cast(x=logits, dtype='float32')
+    probs = fluid.layers.softmax(x=logits)
+    cost = fluid.layers.cross_entropy(input=probs, label=target,
                                       soft_label=False)
     # mask out padded steps via sequence-average
     avg_cost = fluid.layers.mean(
