@@ -1,15 +1,45 @@
 """V5–V7 — the v2 high-level API (trainer/event/parameters/inference)
 over the Fluid executor.
 
-Reference parity: python/paddle/v2/{trainer,event,parameters,inference}.py
-— the v2 user surface (`paddle.parameters.create`, `trainer.SGD(...).train
-(reader, event_handler)`, `paddle.infer`) running on the TPU-native core.
+Reference parity: python/paddle/v2/{__init__,trainer,event,parameters,
+inference}.py — the v2 user surface (`paddle.init(...)`,
+`paddle.parameters.create`, `trainer.SGD(...).train(reader,
+event_handler)`, `paddle.infer`) running on the TPU-native core.
 """
+import os
+
 from . import event
 from . import parameters
 from .inference import Inference, infer
 from .trainer import SGD
 
-__all__ = ['event', 'parameters', 'trainer', 'SGD', 'Inference', 'infer']
+__all__ = ['init', 'event', 'parameters', 'trainer', 'SGD', 'Inference',
+           'infer']
 
 from . import trainer  # noqa: E402
+
+
+def init(**kwargs):
+    """Runtime bring-up (reference python/paddle/v2/__init__.py:init).
+
+    The reference parses --use_gpu/--trainer_count into the C++ runtime;
+    on TPU there is nothing to flag-parse — XLA owns the device — so
+    this absorbs the PADDLE_INIT_* environment the same way and, for
+    multi-host runs (trainer_count > 1 with a coordinator configured),
+    joins the global mesh via distributed.launch.initialize().
+    use_gpu is accepted and ignored (device selection is the Executor
+    place).
+    """
+    merged = {k[len('PADDLE_INIT_'):].lower(): v
+              for k, v in os.environ.items()
+              if k.startswith('PADDLE_INIT_')}
+    merged.update(kwargs)
+    count = int(merged.get('trainer_count', 1) or 1)
+    if count > 1 and (merged.get('pservers') or
+                      os.environ.get('PADDLE_TPU_COORDINATOR')):
+        from ..distributed import launch
+        launch.initialize(
+            coordinator_address=merged.get('pservers'),
+            num_processes=count,
+            process_id=merged.get('trainer_id'))
+    return merged

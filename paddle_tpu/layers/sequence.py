@@ -222,9 +222,10 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
     """Parity with fluid.layers.dynamic_gru: `input` is [B, T, 3H].
 
     use_pallas (default True) engages the fused VMEM-carry time-loop
-    kernel on the TPU backend for default-activation configs without a
-    chained h_0 (ragged and reversed batches included); other configs
-    and non-TPU backends use the identical lax.scan path."""
+    kernel on the TPU backend for default-activation configs — chained
+    h_0 (the seq2seq decoder), ragged, and reversed batches included;
+    other configs and non-TPU backends use the identical lax.scan
+    path."""
     helper = LayerHelper('gru', **kwargs)
     hidden = size
     from ..param_attr import ParamAttr

@@ -274,14 +274,15 @@ _lstm_scan_core.defvjp(_fwd, _bwd)
 
 
 # ---------------------------------------------------------------- GRU
-def _gru_kernel(x_ref, w_ref, o_ref, *o_g_and_scr, hidden, with_gates):
+def _gru_kernel(x_ref, w_ref, h0_ref, o_ref, *o_g_and_scr, hidden,
+                with_gates):
     o_g_ref = o_g_and_scr[0] if with_gates else None
     h_scr = o_g_and_scr[-1]
     t = pl.program_id(0)
 
     @pl.when(t == 0)
     def _init():
-        h_scr[...] = jnp.zeros_like(h_scr[...])
+        h_scr[...] = h0_ref[...].astype(jnp.float32)
 
     g = x_ref[0].astype(jnp.float32)  # [B, 3H] pre-projected gates
     w = w_ref[...].astype(jnp.float32)  # [H, 3H]
@@ -303,7 +304,7 @@ def _gru_kernel(x_ref, w_ref, o_ref, *o_g_and_scr, hidden, with_gates):
 
 
 def _gru_bwd_kernel(gates_ref, hprev_ref, cth_ref, w_ref, dx_ref, dw_ref,
-                    dh_scr, dw_scr, *, hidden, nt):
+                    dh0_ref, dh_scr, dw_scr, *, hidden, nt):
     """Reverse-time GRU BPTT: grid step idx processes t = nt-1-idx; the
     dh chain and dW accumulator live in VMEM (no forward recompute)."""
     idx = pl.program_id(0)
@@ -348,6 +349,8 @@ def _gru_bwd_kernel(gates_ref, hprev_ref, cth_ref, w_ref, dx_ref, dw_ref,
     @pl.when(idx == nt - 1)
     def _finish():
         dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+        # the final dh chain value IS d h0
+        dh0_ref[...] = dh_scr[...].astype(dh0_ref.dtype)
 
 
 def _gru_scan_reference(x_tm, w):
@@ -372,7 +375,7 @@ def _gru_scan_reference(x_tm, w):
     return hs.astype(x_tm.dtype)
 
 
-def _gru_forward(x_tm, w, with_gates, interpret):
+def _gru_forward(x_tm, w, h0, with_gates, interpret):
     t, b, three_h = x_tm.shape
     hidden = three_h // 3
     kernel = functools.partial(_gru_kernel, hidden=hidden,
@@ -391,23 +394,24 @@ def _gru_forward(x_tm, w, with_gates, interpret):
         in_specs=[
             pl.BlockSpec((1, b, three_h), lambda i: (i, 0, 0)),
             pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((b, hidden), jnp.float32)],
         interpret=interpret,
-    )(x_tm, w)
+    )(x_tm, w, h0)
     return out if with_gates else (out[0], None)
 
 
-def _gru_backward(w, hs, gates, ct_h, interpret):
+def _gru_backward(w, h0, hs, gates, ct_h, interpret):
     t, b, three_h = gates.shape
     hidden = three_h // 3
-    zrow = jnp.zeros((1, b, hidden), hs.dtype)
-    h_prev = jnp.concatenate([zrow, hs[:-1]], axis=0)
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]],
+                             axis=0)
     rev = lambda i: (t - 1 - i, 0, 0)
     kernel = functools.partial(_gru_bwd_kernel, hidden=hidden, nt=t)
-    dx, dw = pl.pallas_call(
+    dx, dw, dh0 = pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
@@ -419,10 +423,12 @@ def _gru_backward(w, hs, gates, ct_h, interpret):
         out_specs=[
             pl.BlockSpec((1, b, three_h), rev),
             pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
+            pl.BlockSpec((b, hidden), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, b, three_h), jnp.float32),
             jax.ShapeDtypeStruct((hidden, three_h), jnp.float32),
+            jax.ShapeDtypeStruct((b, hidden), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((b, hidden), jnp.float32),
@@ -430,36 +436,43 @@ def _gru_backward(w, hs, gates, ct_h, interpret):
         ],
         interpret=interpret,
     )(gates, h_prev, ct_h, w)
-    return dx, dw
+    return dx, dw, dh0
 
 
-def gru_scan(x_tm, w, interpret=None):
+def gru_scan(x_tm, w, h0=None, interpret=None):
     """Fused GRU over time-major gates x_tm [T, B, 3H], recurrent weight
-    w [H, 3H] ([:, :2H] update/reset, [:, 2H:] candidate); zero initial
-    state.  Returns hs [T, B, H].  interpret: see lstm_scan."""
+    w [H, 3H] ([:, :2H] update/reset, [:, 2H:] candidate); h0 [B, H]
+    initial state (zeros when None — the seq2seq decoder chains its
+    encoder summary in).  Returns hs [T, B, H].  interpret: see
+    lstm_scan."""
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
-    return _gru_scan_core(x_tm, w, bool(interpret))
+    if h0 is None:
+        h0 = jnp.zeros((x_tm.shape[1], w.shape[0]), jnp.float32)
+    return _gru_scan_core(x_tm, w, h0, bool(interpret))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _gru_scan_core(x_tm, w, interpret):
-    hs, _ = _gru_forward(x_tm, w, with_gates=False, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _gru_scan_core(x_tm, w, h0, interpret):
+    hs, _ = _gru_forward(x_tm, w, h0, with_gates=False,
+                         interpret=interpret)
     return hs
 
 
-def _gru_fwd(x_tm, w, interpret):
-    hs, gates = _gru_forward(x_tm, w, with_gates=True,
+def _gru_fwd(x_tm, w, h0, interpret):
+    hs, gates = _gru_forward(x_tm, w, h0, with_gates=True,
                              interpret=interpret)  # hs f32
     x_tok = jnp.empty((0,), x_tm.dtype)
-    return hs.astype(x_tm.dtype), (x_tok, w, hs, gates)
+    return hs.astype(x_tm.dtype), (x_tok, w, h0, hs, gates)
 
 
 def _gru_bwd(interpret, res, ct):
     # reverse-time BPTT kernel over the saved forward state
-    x_tok, w, hs, gates = res
-    dx, dw = _gru_backward(w, hs, gates, ct, interpret)
-    return dx.astype(x_tok.dtype), dw.astype(w.dtype)
+    x_tok, w, h0, hs, gates = res
+    dx, dw, dh0 = _gru_backward(w, h0.astype(jnp.float32), hs, gates,
+                                ct, interpret)
+    return (dx.astype(x_tok.dtype), dw.astype(w.dtype),
+            dh0.astype(h0.dtype))
 
 
 _gru_scan_core.defvjp(_gru_fwd, _gru_bwd)

@@ -202,17 +202,19 @@ def _gru(ctx, ins, attrs):
         xf = xf + bias.astype(jnp.float32).reshape(1, 1, -1)
 
     backend = getattr(ctx, 'backend', None) or jax.default_backend()
-    if attrs.get('use_pallas') and h0 is None and \
+    if attrs.get('use_pallas') and \
             attrs.get('gate_activation', 'sigmoid') == 'sigmoid' and \
             attrs.get('activation', 'tanh') == 'tanh' and \
             _pallas_rnn_fits_vmem(b, h, threeh) and \
             (backend == 'tpu' or attrs.get('pallas_interpret', False)):
         # fused Pallas time loop (ops/pallas/lstm_cell.gru_scan); ragged
-        # batches run unmasked + zero-mask outside (see the lstm branch)
+        # batches run unmasked + zero-mask outside (see the lstm branch);
+        # a chained h0 (seq2seq decoder) rides the kernel's h0 input
         from .pallas.lstm_cell import gru_scan
         xin, rev_idx = _maybe_reverse(xf, lengths,
                                       attrs.get('is_reverse', False))
-        hs = jnp.swapaxes(gru_scan(jnp.swapaxes(xin, 0, 1), w,
+        h0f = h0.astype(jnp.float32) if h0 is not None else None
+        hs = jnp.swapaxes(gru_scan(jnp.swapaxes(xin, 0, 1), w, h0f,
                                    interpret=backend != 'tpu'), 0, 1)
         hs, = _unreverse_and_mask([hs], rev_idx, lengths, t)
         return {'Hidden': [hs.astype(x.dtype)]}

@@ -77,3 +77,13 @@ def test_v2_trainer_event_loop_and_infer():
     pred_lab = probs.argmax(axis=1)
     true_lab = np.array([int(l) for _, l in batch[:8]])
     assert (pred_lab == true_lab).mean() > 0.5
+
+
+def test_v2_init_absorbs_env(monkeypatch):
+    # reference paddle.init() parity: PADDLE_INIT_* env merges with kwargs
+    import paddle_tpu.highlevel as paddle
+    monkeypatch.setenv('PADDLE_INIT_TRAINER_COUNT', '1')
+    monkeypatch.setenv('PADDLE_INIT_USE_GPU', '0')
+    cfg = paddle.init(use_gpu=False)
+    assert cfg['trainer_count'] == '1'
+    assert cfg['use_gpu'] is False  # kwarg wins over env

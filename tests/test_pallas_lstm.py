@@ -263,3 +263,28 @@ def test_gru_op_pallas_grads_ragged_reverse_match_scan():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4,
                 err_msg='%s rev=%s' % (name, rev))
+
+
+def test_gru_op_pallas_h0_grads_match_scan():
+    """Chained initial state (seq2seq decoder config) rides the kernel:
+    forward AND grads (incl. dh0) equal the scan path."""
+    B, T, H = 3, 6, 8
+    x = rng.randn(B, T, 3 * H).astype('float32')
+    w = (rng.randn(H, 3 * H) * 0.5).astype('float32')
+    h0 = rng.randn(B, H).astype('float32')
+    lens = np.array([6, 2, 4], np.int32)
+    _op_grads.ct = rng.randn(B, T, H).astype('float32')
+    ins = {'Input': x, 'Weight': w, 'H0': h0, 'XLen': lens}
+    want = run_op('gru', ins, {})
+    got = run_op('gru', ins, {'use_pallas': True,
+                              'pallas_interpret': True})
+    np.testing.assert_allclose(np.asarray(got['Hidden'][0]),
+                               np.asarray(want['Hidden'][0]),
+                               rtol=1e-4, atol=1e-5)
+    g_scan = _op_grads('gru', ins, {}, wrt=('Input', 'Weight', 'H0'))
+    g_pal = _op_grads('gru', ins,
+                      {'use_pallas': True, 'pallas_interpret': True},
+                      wrt=('Input', 'Weight', 'H0'))
+    for a, b_, name in zip(g_scan, g_pal, ('dx', 'dw', 'dh0')):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
