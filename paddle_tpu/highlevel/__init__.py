@@ -38,8 +38,11 @@ def init(**kwargs):
     if count > 1 and (merged.get('pservers') or
                       os.environ.get('PADDLE_TPU_COORDINATOR')):
         from ..distributed import launch
+        pservers = merged.get('pservers') or ''
+        # v2 accepts a comma-separated pserver list; the jax coordinator
+        # is a single host:port — process 0's address leads the list
         launch.initialize(
-            coordinator_address=merged.get('pservers'),
+            coordinator_address=pservers.split(',')[0] or None,
             num_processes=count,
             process_id=merged.get('trainer_id'))
     return merged

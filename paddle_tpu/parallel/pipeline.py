@@ -65,10 +65,11 @@ def pipeline_apply(stage_fn, params_shard, microbatches, axis_name,
                                                     microbatches.dtype))
     outs0 = jnp.zeros((M,) + out_shape.shape, out_shape.dtype)
     # the carry varies per mesh member (each holds its stage's activation)
-    try:
+    if hasattr(lax, 'pcast'):
+        buf0 = lax.pcast(buf0, (axis_name,), to='varying')
+        outs0 = lax.pcast(outs0, (axis_name,), to='varying')
+    elif hasattr(lax, 'pvary'):  # older jax spelling
         buf0 = lax.pvary(buf0, (axis_name,))
         outs0 = lax.pvary(outs0, (axis_name,))
-    except AttributeError:  # older jax: vma tracking absent
-        pass
     (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
     return outs

@@ -7,6 +7,10 @@ from paddle_tpu import datasets, models
 
 
 def test_gan_trains():
+    # deterministic: unseeded programs draw a fresh id()-based executor
+    # seed each process, making the adversarial-trend assertion flaky
+    fluid.default_startup_program().random_seed = 11
+    fluid.default_main_program().random_seed = 11
     img, noise, d_loss, g_loss, fake = models.gan.build(img_dim=784)
 
     place = fluid.CPUPlace()

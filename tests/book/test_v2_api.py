@@ -75,7 +75,7 @@ def test_v2_trainer_event_loop_and_infer():
     assert probs.shape == (8, 10)
     np.testing.assert_allclose(probs.sum(axis=1), np.ones(8), rtol=1e-4)
     pred_lab = probs.argmax(axis=1)
-    true_lab = np.array([int(l) for _, l in batch[:8]])
+    true_lab = np.array([int(np.ravel(l)[0]) for _, l in batch[:8]])
     assert (pred_lab == true_lab).mean() > 0.5
 
 
