@@ -1,0 +1,53 @@
+"""Train briefly, export the pruned inference program as a portable
+StableHLO artifact, reload it, and serve predictions (the TPU-native
+counterpart of the reference's capi deployment flow)."""
+import numpy as np
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+from paddle_tpu.inference import InferenceServer, export_inference
+
+
+def main():
+    img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    hidden = fluid.layers.fc(input=img, size=128, act='relu')
+    predict = fluid.layers.fc(input=hidden, size=10, act='softmax')
+    cost = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=predict, label=label))
+    fluid.optimizer.AdamOptimizer(1e-3).minimize(cost)
+
+    place = fluid.default_place()  # TPU when attached
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    reader = fluid.batch(
+        fluid.reader.firstn(datasets.mnist.train(), 512), batch_size=64)
+    for batch in reader():
+        flat = [(np.asarray(im).reshape(784), lb) for im, lb in batch]
+        exe.run(feed=feeder.feed(flat), fetch_list=[cost])
+
+    batch_size = 8
+    path = os.path.join(tempfile.mkdtemp(), 'mnist_mlp.stablehlo')
+    size = export_inference(path, {'img': (batch_size, 784)}, [predict],
+                            executor=exe)
+    print('exported %s (%d bytes)' % (path, size))
+
+    server = InferenceServer(path)  # framework-free reload
+    rng = np.random.default_rng(0)
+    probs = server.predict({'img': rng.normal(
+        size=(batch_size, 784)).astype(np.float32)})
+    probs = np.asarray(probs[0])
+    print('served predictions', probs.shape,
+          'rows sum to', np.round(probs.sum(axis=1), 3)[:3])
+
+
+if __name__ == '__main__':
+    main()

@@ -50,7 +50,7 @@ def _flash_attention(ctx, ins, attrs):
         q, k, v,
         causal=causal,
         scale=scale,
-        block_q=attrs.get('block_q', 512),
-        block_k=attrs.get('block_k', 512),
+        block_q=attrs.get('block_q'),   # None -> head-dim-aware auto
+        block_k=attrs.get('block_k'),
         interpret=backend != 'tpu')
     return out(y.astype(q.dtype))
