@@ -22,7 +22,7 @@ from .core import (Block, CPUPlace, CUDAPlace, LoDTensor, Operator,  # noqa
                    Parameter, Program, Scope, TPUPlace, Variable, XLAPlace,
                    create_lod_tensor, default_main_program,
                    default_startup_program, global_scope, grad_var_name,
-                   name_scope, program_guard, scope_guard,
+                   name_scope, program_guard, scope_guard, switch_scope,
                    switch_main_program, switch_startup_program, unique_name, default_place)
 from .core.executor import Executor
 from .core import backward
@@ -72,5 +72,6 @@ __all__ = [
     'Scope', 'LoDTensor', 'Tensor', 'ParamAttr', 'DataFeeder',
     'CPUPlace', 'CUDAPlace', 'TPUPlace', 'XLAPlace', 'default_place',
     'default_main_program', 'default_startup_program', 'program_guard',
-    'scope_guard', 'global_scope', 'append_backward', 'unique_name',
+    'scope_guard', 'switch_scope', 'global_scope', 'append_backward',
+    'unique_name',
 ]

@@ -9,4 +9,5 @@ from .program import (Block, Operator, Parameter, Program,  # noqa: F401
                       program_guard, switch_main_program,
                       switch_startup_program, unique_name)
 from .registry import register_op, registered_ops  # noqa: F401
-from .scope import Scope, global_scope, scope_guard  # noqa: F401
+from .scope import (Scope, global_scope, scope_guard,  # noqa: F401
+                    switch_scope)

@@ -70,6 +70,15 @@ def global_scope():
     return _global_scope
 
 
+def switch_scope(scope):
+    """Swap the global scope, returning the previous one (reference
+    executor.py:switch_scope)."""
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old
+
+
 def scope_guard(scope):
     import contextlib
 

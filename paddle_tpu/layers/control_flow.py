@@ -22,6 +22,8 @@ __all__ = [
     'array_to_lod_tensor', 'increment', 'array_write', 'create_array',
     'array_read', 'array_length', 'shrink_memory', 'less_than', 'equal',
     'Print', 'ParallelDo', 'split_lod_tensor', 'merge_lod_tensor',
+    'BlockGuard', 'WhileGuard', 'BlockGuardWithCompletion',
+    'StaticRNNMemoryLink', 'reorder_lod_tensor_by_rank',
 ]
 
 from .tensor import less_than, equal  # re-export (fluid puts them here)
@@ -576,3 +578,45 @@ class ParallelDo(object):
     def __call__(self):
         outs = self._outputs
         return outs[0] if len(outs) == 1 else outs
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, **kwargs):
+    """Reorder batch rows by the rank table's descending-length order
+    (ref fluid/layers/control_flow.py:reorder_lod_tensor_by_rank over
+    operators/reorder_lod_tensor_by_rank_op.cc).  The reordered lengths
+    ride along as the output's @LEN companion so downstream ragged ops
+    keep masking correctly."""
+    helper = LayerHelper('reorder_lod_tensor_by_rank', **kwargs)
+    block = helper.main_program.current_block()
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    out_len = block.create_var(name=out.name + LEN_SUFFIX, shape=[-1],
+                               dtype='int32')
+    out_len.stop_gradient = True
+    order = helper.create_tmp_variable('int32')
+    helper.append_op(
+        type='reorder_lod_tensor_by_rank',
+        inputs={'X': [x], 'RankTable': [rank_table]},
+        outputs={'Out': [out], 'OutLen': [out_len],
+                 'OrderedIndex': [order]})
+    return out
+
+
+class BlockGuardWithCompletion(BlockGuard):
+    """Parity alias (ref fluid/layers/control_flow.py): a BlockGuard
+    that completes its op on exit — our StaticRNN/While builders do the
+    completion in their own __exit__, so this is the plain guard."""
+
+    def __init__(self, rnn):
+        super(BlockGuardWithCompletion, self).__init__(
+            rnn.helper.main_program)
+        self.rnn = rnn
+
+
+class StaticRNNMemoryLink(object):
+    """Parity record (ref fluid/layers/control_flow.py): links an
+    init-state var to its per-step memory var inside StaticRNN."""
+
+    def __init__(self, init, pre_mem, mem=None):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = mem

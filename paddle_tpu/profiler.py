@@ -10,7 +10,8 @@ import time
 
 import jax
 
-__all__ = ['profiler', 'cuda_profiler', 'reset_profiler', 'RecordEvent',
+__all__ = ['profiler', 'cuda_profiler', 'CudaProfiler',
+           'reset_profiler', 'RecordEvent',
            'start_profiler', 'stop_profiler']
 
 _events = []
@@ -36,8 +37,10 @@ def profiler(state='All', sorted_key=None, log_dir='/tmp/paddle_tpu_prof'):
         _events.append(('profile_region', time.time() - t0))
 
 
-# The reference exposes cuda_profiler; on TPU it is the same XLA trace.
+# The reference exposes cuda_profiler/CudaProfiler; on TPU both are the
+# same XLA trace context.
 cuda_profiler = profiler
+CudaProfiler = profiler
 
 
 def start_profiler(state='All', log_dir='/tmp/paddle_tpu_prof'):

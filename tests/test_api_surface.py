@@ -1,0 +1,62 @@
+"""API-surface parity: every public name the reference's fluid modules
+export must exist here (the 'switch with an import change' contract).
+Skipped when the reference checkout isn't mounted."""
+import os
+import re
+
+import pytest
+
+import paddle_tpu as fluid
+
+REF = '/root/reference/python/paddle/v2/fluid'
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason='reference checkout not mounted')
+
+
+def _exported(path):
+    """Names a module exports: the literal __all__ list plus any
+    `submodule.__all__` terms it concatenates (the reference top-level
+    does `__all__ = framework.__all__ + executor.__all__ + [...]`)."""
+    src = open(path).read()
+    names = set()
+    for m in re.finditer(r"__all__ \+?= (.*?)\[(.*?)\]", src, re.S):
+        names.update(re.findall(r"'([^']+)'", m.group(2)))
+        for sub in re.findall(r"(\w+)\.__all__", m.group(1)):
+            sub_path = os.path.join(os.path.dirname(path), sub + '.py')
+            if os.path.exists(sub_path):
+                names.update(_exported(sub_path))
+    return names
+
+
+def _missing(path, mod):
+    return sorted(n for n in _exported(path) if not hasattr(mod, n))
+
+
+def test_fluid_top_level_surface():
+    assert _missing(os.path.join(REF, '__init__.py'), fluid) == []
+
+
+def test_layers_surface():
+    import glob
+    names = set()
+    for f in glob.glob(os.path.join(REF, 'layers', '*.py')):
+        names.update(_exported(f))
+    missing = sorted(n for n in names if not hasattr(fluid.layers, n))
+    assert missing == [], missing
+
+
+@pytest.mark.parametrize('mod_name', [
+    'io', 'nets', 'optimizer', 'regularizer', 'initializer', 'clip',
+    'evaluator', 'profiler',
+])
+def test_module_surfaces(mod_name):
+    path = os.path.join(REF, mod_name + '.py')
+    mod = getattr(fluid, mod_name)
+    assert _missing(path, mod) == [], mod_name
+
+
+def test_v2_reader_surface():
+    import paddle_tpu.reader as r
+    path = '/root/reference/python/paddle/v2/reader/__init__.py'
+    assert _missing(path, r) == []

@@ -145,3 +145,23 @@ def test_lod_reset():
     outs = run_op('lod_reset', {'X': x}, {'target_lod': [2, 4, 1]})
     np.testing.assert_allclose(np.asarray(outs['Out'][0]), x, rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(outs['OutLen'][0]), target)
+
+
+def test_reorder_lod_tensor_by_rank_layer_keeps_lengths():
+    """The layer wires OutLen as the output's @LEN companion so ragged
+    consumers (sequence_pool etc.) mask the REORDERED lengths."""
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        y = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        pooled = fluid.layers.sequence_pool(input=y, pool_type='sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x])
+    rows = [([1.0, 2.0],), ([3.0, 4.0, 5.0],), ([6.0],)]
+    got, = exe.run(main, feed=feeder.feed(rows), fetch_list=[pooled])
+    got = np.asarray(got).ravel()
+    # descending-length order: [3+4+5, 1+2, 6] — padded tail masked
+    np.testing.assert_allclose(got, [12.0, 3.0, 6.0], rtol=1e-6)
