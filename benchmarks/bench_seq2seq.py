@@ -18,7 +18,8 @@ def main():
         main_p, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_p, startup):
             src, trg, label, pred, avg_cost = seq2seq.build(
-                dict_size=vocab, word_dim=dim // 2, hidden_dim=dim)
+                dict_size=vocab, word_dim=dim // 2, hidden_dim=dim,
+                dtype='bfloat16')
             fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
         return main_p, startup, avg_cost
 
@@ -33,8 +34,8 @@ def main():
 
     run_bench('seq2seq_attention_tokens_per_sec', batch * seq, build,
               feed, steps=10 if on_tpu() else 3,
-              note='batch=%d seq=%d vocab=%d dim=%d' % (batch, seq,
-                                                        vocab, dim))
+              note='batch=%d seq=%d vocab=%d dim=%d bf16' % (
+                  batch, seq, vocab, dim))
 
 
 if __name__ == '__main__':

@@ -22,8 +22,8 @@ def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2,
                                lod_level=1)
     emb = fluid.layers.embedding(input=src, size=[vocab_size, emb_dim])
     x = emb
-    if dtype == 'bfloat16':
-        x = fluid.layers.cast(x=x, dtype='bfloat16')
+    if dtype in ('bfloat16', 'float16'):
+        x = fluid.layers.cast(x=x, dtype=dtype)
     for i in range(num_layers):
         fc = fluid.layers.fc(input=x, size=hidden_dim * 4,
                              num_flatten_dims=2)
@@ -32,7 +32,7 @@ def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2,
     # vocab-head matmul in the activation dtype; softmax in fp32
     logits = fluid.layers.fc(input=x, size=vocab_size, num_flatten_dims=2,
                              act=None)
-    if dtype == 'bfloat16':
+    if dtype in ('bfloat16', 'float16'):
         logits = fluid.layers.cast(x=logits, dtype='float32')
     probs = fluid.layers.softmax(x=logits)
     cost = fluid.layers.cross_entropy(input=probs, label=target,

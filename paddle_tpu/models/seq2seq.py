@@ -27,10 +27,13 @@ def _attr(name):
     return ParamAttr(name=name)
 
 
-def encoder(src_word_id, dict_size, word_dim=32, hidden_dim=32):
+def encoder(src_word_id, dict_size, word_dim=32, hidden_dim=32,
+            dtype='float32'):
     src_embedding = fluid.layers.embedding(
         input=src_word_id, size=[dict_size, word_dim], dtype='float32',
         param_attr=_attr('mt_src_emb'))
+    if dtype in ('bfloat16', 'float16'):
+        src_embedding = fluid.layers.cast(x=src_embedding, dtype=dtype)
     fc_forward = fluid.layers.fc(
         input=src_embedding, size=hidden_dim * 3, num_flatten_dims=2,
         param_attr=_attr('mt_enc_fc_fwd_w'),
@@ -70,24 +73,33 @@ def _attend_and_score(dec_states, encoded, enc_proj, dict_size):
     """Shared attention + vocab head: dec_states [B, Td|K, H] against the
     padded encoder states — Luong scores, masked softmax, context concat,
     softmax output fc.  Used verbatim by BOTH the teacher-forced train
-    path and the per-step beam decode so the two can never drift."""
+    path and the per-step beam decode so the two can never drift.  Under
+    bf16 activations the vocab matmul runs bf16 and only the softmax is
+    computed over fp32 logits."""
     scores = fluid.layers.matmul(dec_states, enc_proj, transpose_y=True)
     attn = fluid.layers.sequence_softmax(
         input=scores, length_input=encoded, axis=2)
     context = fluid.layers.matmul(attn, encoded)
     combined = fluid.layers.concat(input=[dec_states, context], axis=2)
-    return fluid.layers.fc(
-        input=combined, size=dict_size, num_flatten_dims=2, act='softmax',
+    logits = fluid.layers.fc(
+        input=combined, size=dict_size, num_flatten_dims=2, act=None,
         param_attr=_attr('mt_out_fc_w'), bias_attr=_attr('mt_out_fc_b'))
+    probs = logits
+    if probs.dtype in ('bfloat16', 'float16'):
+        probs = fluid.layers.cast(x=probs, dtype='float32')
+    return fluid.layers.softmax(x=probs)
 
 
-def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32):
-    encoded = encoder(src, dict_size, word_dim, hidden_dim)
+def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32,
+              dtype='float32'):
+    encoded = encoder(src, dict_size, word_dim, hidden_dim, dtype=dtype)
     dec_h0 = _decoder_init(encoded, hidden_dim)
 
     trg_embedding = fluid.layers.embedding(
         input=trg, size=[dict_size, word_dim], dtype='float32',
         param_attr=_attr('mt_trg_emb'))
+    if dtype in ('bfloat16', 'float16'):
+        trg_embedding = fluid.layers.cast(x=trg_embedding, dtype=dtype)
     dec_fc = fluid.layers.fc(
         input=trg_embedding, size=hidden_dim * 3, num_flatten_dims=2,
         param_attr=_attr('mt_dec_fc_w'), bias_attr=_attr('mt_dec_fc_b'))
@@ -104,8 +116,10 @@ def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32):
     return prediction, avg_cost
 
 
-def build(dict_size, word_dim=32, hidden_dim=32):
-    """Returns (src, trg, label, prediction, avg_cost)."""
+def build(dict_size, word_dim=32, hidden_dim=32, dtype='float32'):
+    """Returns (src, trg, label, prediction, avg_cost).  dtype='bfloat16'
+    runs embeddings/projections/GRU gates/vocab head in bf16 with fp32
+    master weights; the softmax and loss stay fp32."""
     src = fluid.layers.data(name='src_word_id', shape=[1], dtype='int64',
                             lod_level=1)
     trg = fluid.layers.data(name='target_language_word', shape=[1],
@@ -113,7 +127,7 @@ def build(dict_size, word_dim=32, hidden_dim=32):
     label = fluid.layers.data(name='target_language_next_word', shape=[1],
                               dtype='int64', lod_level=1)
     prediction, avg_cost = train_net(src, trg, label, dict_size, word_dim,
-                                     hidden_dim)
+                                     hidden_dim, dtype=dtype)
     return src, trg, label, prediction, avg_cost
 
 

@@ -165,3 +165,25 @@ def test_reorder_lod_tensor_by_rank_layer_keeps_lengths():
     got = np.asarray(got).ravel()
     # descending-length order: [3+4+5, 1+2, 6] — padded tail masked
     np.testing.assert_allclose(got, [12.0, 3.0, 6.0], rtol=1e-6)
+
+
+def test_cast_preserves_ragged_lengths():
+    """layers.cast keeps lod + the @LEN companion: a bf16-cast ragged
+    sequence must still mask (regression: pre-fix, cast dropped @LEN and
+    downstream RNNs ran unmasked over padding)."""
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        xb = fluid.layers.cast(x=x, dtype='bfloat16')
+        assert xb.lod_level == 1
+        pooled = fluid.layers.sequence_pool(
+            input=fluid.layers.cast(x=xb, dtype='float32'),
+            pool_type='sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x])
+    rows = [([1.0, 2.0],), ([3.0],)]
+    got, = exe.run(main, feed=feeder.feed(rows), fetch_list=[pooled])
+    np.testing.assert_allclose(np.asarray(got).ravel(), [3.0, 3.0],
+                               rtol=1e-2)  # padding masked, bf16 tol
