@@ -33,7 +33,8 @@ def main():
 
     run_bench('stacked_lstm_tokens_per_sec', batch * seq, build, feed,
               steps=10 if on_tpu() else 3,
-              note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab))
+              note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab),
+              dtype='bfloat16')
 
 
 if __name__ == '__main__':

@@ -34,8 +34,9 @@ def main():
 
     run_bench('seq2seq_attention_tokens_per_sec', batch * seq, build,
               feed, steps=10 if on_tpu() else 3,
-              note='batch=%d seq=%d vocab=%d dim=%d bf16' % (
-                  batch, seq, vocab, dim))
+              note='batch=%d seq=%d vocab=%d dim=%d' % (batch, seq,
+                                                        vocab, dim),
+              dtype='bfloat16')
 
 
 if __name__ == '__main__':

@@ -19,7 +19,7 @@ def on_tpu():
 
 
 def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
-              note=None):
+              note=None, dtype=None):
     """build() -> (program, startup, loss_var); feed_fn() -> feed dict.
     unit_count = units (imgs/tokens/examples) per step."""
     import jax
@@ -49,6 +49,10 @@ def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
         "metric": metric,
         "value": round(unit_count * steps / dt, 2),
     }
+    if dtype:
+        # structured workload marker: keeps the metric key stable across
+        # the fp32 -> bf16 config change while making it machine-visible
+        result["dtype"] = dtype
     if note:
         result["note"] = note
     print(json.dumps(result))

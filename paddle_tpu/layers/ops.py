@@ -33,15 +33,18 @@ def _register_unary(op_type):
         if x is None:
             x = kwargs.pop('input', None) or kwargs.pop('X')
         helper = LayerHelper(op_type, **kwargs)
-        # elementwise: ragged structure passes through (lod + @LEN)
-        out = helper.create_tmp_variable(dtype=x.dtype,
-                                         lod_level=x.lod_level)
+        # elementwise ops pass the ragged structure through (lod + @LEN);
+        # reductions (mean) collapse it
+        elementwise = op_type != 'mean'
+        out = helper.create_tmp_variable(
+            dtype=x.dtype, lod_level=x.lod_level if elementwise else 0)
         out_slot = {'mean': 'Out', 'softmax': 'Out',
                     'sequence_softmax': 'Out'}.get(op_type, 'Out')
         helper.append_op(type=op_type, inputs={'X': [x]},
                          outputs={out_slot: [out]}, attrs=kwargs.get('attrs',
                                                                      {}))
-        helper.copy_len(x, out)
+        if elementwise:
+            helper.copy_len(x, out)
         return out
 
     _layer.__name__ = op_type

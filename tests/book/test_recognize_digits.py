@@ -28,7 +28,7 @@ def test_recognize_digits(nn_type):
         for data in train_reader():
             cost_v, acc_v = exe.run(feed=feeder.feed(data),
                                     fetch_list=[avg_cost, acc])
-            accs.append(float(acc_v))
+            accs.append(float(np.ravel(acc_v)[0]))
         if np.mean(accs[-10:]) > 0.9:
             break
     assert np.mean(accs[-10:]) > 0.9, \
