@@ -66,8 +66,9 @@ def main():
         "metric": "flash_attention_causal_train_tokens_per_sec",
         "value": round(tokens_s, 2),
         "achieved_tflops": round(flops / dt_s / 1e12, 2),
-        "note": "B=%d T=%d H=%d D=%d fwd+bwd %s" % (
-            B, T, H, D, 'bf16' if tpu else 'cpu-smoke'),
+        "dtype": "bfloat16" if tpu else "float32",
+        "note": "B=%d T=%d H=%d D=%d fwd+bwd%s" % (
+            B, T, H, D, '' if tpu else ' cpu-smoke'),
     }))
 
 

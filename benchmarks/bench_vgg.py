@@ -40,7 +40,8 @@ def main():
 
     run_bench('vgg16_train_img_per_sec', batch, build, feed,
               steps=10 if on_tpu() else 3,
-              note='batch=%d hw=%d bf16 NHWC' % (batch, hw))
+              note='batch=%d hw=%d NHWC' % (batch, hw),
+              dtype='bfloat16')
 
 
 if __name__ == '__main__':

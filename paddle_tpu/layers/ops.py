@@ -17,6 +17,11 @@ __unary__ = __activations__ + [
     'mean', 'softmax', 'sign',
 ]
 
+# reductions collapse the ragged structure (and, for mean, average over
+# the REAL elements via the @LEN companion); everything else in
+# __unary__ is elementwise and passes lod + @LEN through
+__reductions__ = {'mean'}
+
 __binary__ = [
     'mul', 'elementwise_add', 'elementwise_div', 'elementwise_sub',
     'elementwise_mul', 'elementwise_max', 'elementwise_min',
@@ -33,16 +38,18 @@ def _register_unary(op_type):
         if x is None:
             x = kwargs.pop('input', None) or kwargs.pop('X')
         helper = LayerHelper(op_type, **kwargs)
-        # elementwise ops pass the ragged structure through (lod + @LEN);
-        # reductions (mean) collapse it
-        elementwise = op_type != 'mean'
+        elementwise = op_type not in __reductions__
         out = helper.create_tmp_variable(
             dtype=x.dtype, lod_level=x.lod_level if elementwise else 0)
-        out_slot = {'mean': 'Out', 'softmax': 'Out',
-                    'sequence_softmax': 'Out'}.get(op_type, 'Out')
-        helper.append_op(type=op_type, inputs={'X': [x]},
-                         outputs={out_slot: [out]}, attrs=kwargs.get('attrs',
-                                                                     {}))
+        inputs = {'X': [x]}
+        if not elementwise:
+            # reductions over ragged inputs must see the lengths so they
+            # aggregate real elements only (ops/math.py mean XLen path)
+            from .sequence import _len_input
+            inputs.update(_len_input(helper, x))
+        helper.append_op(type=op_type, inputs=inputs,
+                         outputs={'Out': [out]},
+                         attrs=kwargs.get('attrs', {}))
         if elementwise:
             helper.copy_len(x, out)
         return out

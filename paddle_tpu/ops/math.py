@@ -7,6 +7,7 @@ accumulating in fp32 (the TPU-native mixed-precision recipe).
 """
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import register_op
 from .common import bcast_axis, first, out
@@ -108,8 +109,24 @@ def _minus(ctx, ins, attrs):
 
 @register_op('mean')
 def _mean(ctx, ins, attrs):
+    """mean_op.cc parity.  For a ragged input (XLen companion wired by
+    the layer) the reference's LoDTensor holds only REAL elements, so the
+    padded-dense equivalent averages over valid positions only — a plain
+    mean would dilute short sequences with padding."""
     x = first(ins, 'X')
-    return out(jnp.mean(x.astype(jnp.float32)).astype(x.dtype).reshape((1,)))
+    lengths = first(ins, 'XLen')
+    xf = x.astype(jnp.float32)
+    if lengths is None:
+        m = jnp.mean(xf)
+    else:
+        ln = lengths.astype(jnp.int32).reshape(-1)
+        t = x.shape[1]
+        mask = (jnp.arange(t)[None, :] < ln[:, None])
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        feat = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
+        m = jnp.sum(jnp.where(mask, xf, 0.0)) / \
+            jnp.maximum(jnp.sum(ln) * feat, 1)
+    return out(m.astype(x.dtype).reshape((1,)))
 
 
 @register_op('clip')

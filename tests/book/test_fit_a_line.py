@@ -28,8 +28,8 @@ def test_fit_a_line_converges():
         for data in train_reader():
             out, = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost])
             if first is None:
-                first = float(out)
-            last = float(out)
+                first = float(np.ravel(out)[0])
+            last = float(np.ravel(out)[0])
         if last < 12.0:
             break
     assert last < first, (first, last)

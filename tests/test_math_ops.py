@@ -105,3 +105,20 @@ def test_cos_sim():
     ref = (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
                             np.linalg.norm(y, axis=1))
     np.testing.assert_allclose(o.ravel(), ref, rtol=1e-4)
+
+
+def test_mean_masks_ragged_inputs():
+    """layers.mean over a ragged tensor averages REAL elements only
+    (reference LoDTensor mean semantics) — padding must not dilute."""
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        m = fluid.layers.mean(x=x)
+        assert m.lod_level == 0
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x])
+    rows = [([2.0, 4.0],), ([6.0],)]   # real mean = 4.0; padded would be 3
+    got, = exe.run(main, feed=feeder.feed(rows), fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(got).ravel(), [4.0], rtol=1e-6)
