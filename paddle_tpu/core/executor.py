@@ -422,7 +422,7 @@ class Executor(object):
             for n in sorted(feed_arrays))
         state_rw_names, state_ro_names, state_out_names = \
             self._analyze_state(program, scope, set(feed_arrays))
-        key = (id(program), program.version, feed_sig, fetch_names,
+        key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names, id(scope))
         if use_cache and key in self._cache:
             return self._cache[key]

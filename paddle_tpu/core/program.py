@@ -9,6 +9,7 @@ for the reference's per-op kernel dispatch loop (paddle/framework/executor.cc).
 import collections
 import contextlib
 import copy
+import itertools
 import json
 
 import numpy as np
@@ -339,6 +340,8 @@ class Block(object):
 
 
 class Program(object):
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
@@ -346,6 +349,9 @@ class Program(object):
         self._version = 0
         self._seed_counter = 0
         self._current_role = 'forward'
+        # process-unique identity: unlike id(), never reused after GC, so
+        # caches keyed on it can't serve a stale entry to a new Program
+        self._uid = next(Program._uid_counter)
 
     @contextlib.contextmanager
     def op_role_guard(self, role):
@@ -397,6 +403,7 @@ class Program(object):
         `is_test` attr (dropout scales by keep-prob, batch_norm uses running
         stats) — parity with fluid Program.clone + inference_optimize."""
         p = copy.deepcopy(self)
+        p._uid = next(Program._uid_counter)  # a clone is a new identity
         if for_test:
             for block in p.blocks:
                 for op in block.ops:
@@ -417,6 +424,7 @@ class Program(object):
         feed_names = set(
             f.name if isinstance(f, Variable) else f for f in _as_list(feeds))
         p = copy.deepcopy(self)
+        p._uid = next(Program._uid_counter)  # a pruned copy is a new identity
         for block in p.blocks:
             needed = set(target_names)
             kept = []

@@ -98,7 +98,37 @@ class DistributeTranspiler(object):
 
 
 class SimpleDistributeTranspiler(DistributeTranspiler):
-    """Reference SimpleDistributeTranspiler parity (round-robin whole
-    -var placement): same mesh plan, but shards only vars that divide
-    evenly (whole-tensor ownership)."""
-    pass
+    """Reference SimpleDistributeTranspiler parity: round-robin WHOLE-var
+    placement (reference distribute_transpiler_simple round_robin() — no
+    intra-var splitting).  Each mesh member owns entire parameters; the
+    ownership map drives per-member checkpointing (io.save_checkpoint
+    sharding) and introspection.  Execution keeps tensors replicated —
+    whole-var ownership has no intra-tensor split for GSPMD to exploit,
+    so the plan is PartitionSpec() for every var."""
+
+    def transpile(self, trainer_id=0, program=None, pservers=None,
+                  trainers=1, split_method=None, mesh=None,
+                  fsdp_axis='fsdp'):
+        self.program = program or default_main_program()
+        if mesh is None:
+            n = max(1, trainers)
+            mesh = api.make_mesh((n,), (fsdp_axis,))
+        self.mesh = mesh
+        self.fsdp_axis = fsdp_axis
+        self.trainer_id = trainer_id
+        n_members = int(np.prod(mesh.devices.shape))
+        params = self.program.global_block().all_parameters()
+        # reference round_robin: walk vars in declaration order, assign
+        # each whole var to the next member in turn
+        self._placement = {p.name: i % n_members
+                           for i, p in enumerate(params)}
+        return self
+
+    def get_pserver_program(self, endpoint=None):
+        """Return {param_name: member_index} for vars owned by
+        `endpoint` (a member index), or the full placement map when
+        endpoint is None."""
+        placement = getattr(self, '_placement', {})
+        if endpoint is None:
+            return dict(placement)
+        return {n: m for n, m in placement.items() if m == int(endpoint)}

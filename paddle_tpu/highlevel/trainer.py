@@ -6,6 +6,8 @@ EndIteration/EndPass events.  The reference drives the legacy C++
 GradientMachine; here the same surface drives the one-HLO-per-step
 Executor, so a v2-style script runs unchanged on TPU.
 """
+import warnings
+
 import numpy as np
 
 from . import event as v2_event
@@ -75,6 +77,23 @@ class SGD(object):
             block, 'iter_vars') else list(block.vars.values())
         data_vars = [v for v in data_vars if getattr(v, 'is_data', False)]
         if feeding is None:
+            # Declaration order is the only available pairing; it is
+            # silently wrong if the reader yields columns in another
+            # order, so refuse ambiguous batches and say so once.
+            ncols = len(data_batch[0]) if data_batch else len(data_vars)
+            if ncols != len(data_vars):
+                raise ValueError(
+                    "reader yields %d columns but the program declares %d "
+                    "data layers (%s); pass feeding={name: column_index} "
+                    "to pair them explicitly" %
+                    (ncols, len(data_vars), [v.name for v in data_vars]))
+            if len(data_vars) > 1 and not getattr(self, '_warned_order', 0):
+                self._warned_order = 1
+                warnings.warn(
+                    "no `feeding` map given; pairing reader columns to "
+                    "data layers by declaration order (%s) — pass "
+                    "feeding={name: column_index} if the reader's column "
+                    "order differs" % [v.name for v in data_vars])
             return data_vars  # program declaration order
         order = sorted(feeding, key=lambda k: feeding[k])
         return [block.var(n) for n in order]

@@ -4,13 +4,29 @@ Reference parity: python/paddle/v2/fluid/io.py.  Variables serialize as .npy
 files (one per var, like the reference's one-file-per-var layout); the
 inference program serializes as JSON (core/program.py), playing the role of
 the reference's ProgramDesc protobuf `__model__` file.
+
+Sharding-aware checkpointing (reference io.py:191 save_persistables +
+the pserver owning param shards): a var whose scope value is a jax.Array
+with a non-replicated NamedSharding is saved as one file PER UNIQUE SHARD
+(each host writes only its addressable shards — no host-gather of the
+full tensor), with the PartitionSpec recorded in `__manifest__.json`.
+Loading under a live mesh_guard reassembles the array directly onto the
+mesh via jax.make_array_from_callback with the saved spec; loading with
+no mesh yields the assembled numpy array.  The manifest also records
+shape/dtype for every var, checked at load time so restoring into a
+changed program fails loudly instead of corrupting the scope.
 """
+import json
 import os
 
 import numpy as np
 
+from .core.datatypes import as_numpy_dtype
 from .core.program import Parameter, Program, Variable, default_main_program
 from .core.scope import global_scope
+
+_MANIFEST = '__manifest__.json'
+_FORMAT_VERSION = 1
 
 __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
@@ -29,6 +45,41 @@ def is_persistable(var):
     return var.persistable
 
 
+def _sharding_of(value):
+    """(PartitionSpec-as-list, mesh) if value is a mesh-sharded jax.Array,
+    else (None, None)."""
+    import jax
+    from jax.sharding import NamedSharding
+    if not isinstance(value, jax.Array):
+        return None, None
+    sh = getattr(value, 'sharding', None)
+    if not isinstance(sh, NamedSharding) or sh.is_fully_replicated:
+        return None, None
+    spec = [list(s) if isinstance(s, tuple) else s for s in sh.spec]
+    return spec, sh.mesh
+
+
+def _save_sharded(dirname, name, value):
+    """One .npy per unique shard (dedup replicated copies by index);
+    returns the manifest shard records.  Indices are normalized to
+    concrete (start, stop) bounds — jax yields slice(None) for unsharded
+    dims — so the load-time lookup matches exactly."""
+    seen = {}
+    shape = value.shape
+    for shard in value.addressable_shards:
+        idx = tuple((sl.start if sl.start is not None else 0,
+                     sl.stop if sl.stop is not None else shape[d])
+                    for d, sl in enumerate(shard.index))
+        if idx in seen:
+            continue
+        k = len(seen)
+        np.save(os.path.join(dirname, '%s.shard%d.npy' % (_safe(name), k)),
+                np.asarray(shard.data))
+        seen[idx] = k
+    return [{'index': [list(p) for p in idx], 'file': k}
+            for idx, k in seen.items()]
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None):
     if vars is None:
@@ -37,13 +88,40 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         vars = list(filter(predicate, main_program.list_vars()))
     os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
+    manifest = _read_manifest(dirname) or {
+        'format_version': _FORMAT_VERSION, 'vars': {}}
     for var in vars:
         name = var.name if isinstance(var, Variable) else var
         value = scope.find_var(name)
         if value is None:
             continue
-        np.save(os.path.join(dirname, _safe(name) + '.npy'),
-                np.asarray(value))
+        rec = {'shape': [int(d) for d in np.shape(value)],
+               'dtype': str(np.asarray(value).dtype
+                            if not hasattr(value, 'dtype')
+                            else value.dtype)}
+        spec, _mesh = _sharding_of(value)
+        if spec is not None:
+            rec['spec'] = spec
+            rec['shards'] = _save_sharded(dirname, name, value)
+        else:
+            np.save(os.path.join(dirname, _safe(name) + '.npy'),
+                    np.asarray(value))
+        manifest['vars'][name] = rec
+    with open(os.path.join(dirname, _MANIFEST), 'w') as f:
+        json.dump(manifest, f)
+
+
+def _read_manifest(dirname):
+    path = os.path.join(dirname, _MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        m = json.load(f)
+    if m.get('format_version', 0) > _FORMAT_VERSION:
+        raise ValueError(
+            "checkpoint %s was written by a newer format (version %s > %s)"
+            % (dirname, m.get('format_version'), _FORMAT_VERSION))
+    return m
 
 
 def save_params(executor, dirname, main_program=None):
@@ -56,18 +134,116 @@ def save_persistables(executor, dirname, main_program=None):
               predicate=is_persistable)
 
 
+def _check_against_program(name, var, shape, dtype):
+    """Fail loudly when a checkpoint value disagrees with the program's
+    declaration (Variable shape may use -1/None for the batch dim)."""
+    if not isinstance(var, Variable):
+        return
+    decl = getattr(var, 'shape', None)
+    if decl:
+        decl = tuple(int(d) for d in decl)
+        got = tuple(shape)
+        ok = len(decl) == len(got) and all(
+            d in (-1, 0) or d == g for d, g in zip(decl, got))
+        if not ok:
+            raise ValueError(
+                "checkpoint var '%s' has shape %s but the program declares "
+                "%s — the model changed since this checkpoint was saved" %
+                (name, got, decl))
+    vdt = getattr(var, 'dtype', None)
+    if vdt is not None:
+        want = np.dtype(as_numpy_dtype(vdt))
+        if np.dtype(dtype) != want:
+            raise ValueError(
+                "checkpoint var '%s' has dtype %s but the program declares "
+                "%s" % (name, dtype, want))
+
+
+def _load_sharded(dirname, name, rec):
+    """Reassemble a sharded var.  Under a live mesh_guard the result is
+    built directly onto the mesh with the saved PartitionSpec (each host
+    reads only the shards it needs); otherwise the full numpy array."""
+    shape = tuple(rec['shape'])
+    dtype = np.dtype(rec['dtype'])
+    shard_files = {
+        tuple(tuple(p) for p in s['index']):
+            os.path.join(dirname, '%s.shard%d.npy' % (_safe(name),
+                                                      s['file']))
+        for s in rec['shards']}
+
+    def piece(index):
+        idx = tuple((sl.start if sl.start is not None else 0,
+                     sl.stop if sl.stop is not None else shape[d])
+                    for d, sl in enumerate(index))
+        if idx in shard_files:
+            return _np_load(shard_files[idx], dtype)
+        # requested block differs from the saved tiling (different mesh
+        # size): assemble the full array once and slice
+        return _assemble(shape, dtype, shard_files)[index]
+
+    from .parallel import api
+    mesh = api.current_mesh()
+    spec = rec.get('spec')
+    if mesh is not None and spec is not None and all(
+            a in mesh.axis_names for part in spec if part
+            for a in (part if isinstance(part, list) else [part])):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        parts = [tuple(p) if isinstance(p, list) else p for p in spec]
+        sharding = NamedSharding(mesh, PartitionSpec(*parts))
+        return jax.make_array_from_callback(shape, sharding, piece)
+    return _assemble(shape, dtype, shard_files)
+
+
+def _np_load(path, dtype):
+    """np.load with an ml_dtypes repair: numpy serializes bfloat16 as a
+    raw void dtype (|V2), so reinterpret the buffer as the manifest's
+    dtype when they disagree."""
+    arr = np.load(path)
+    dtype = np.dtype(dtype)
+    if arr.dtype != dtype and arr.dtype.itemsize == dtype.itemsize:
+        arr = arr.view(dtype)
+    return arr
+
+
+def _assemble(shape, dtype, shard_files):
+    full = np.empty(shape, dtype=dtype)
+    for idx, path in shard_files.items():
+        sl = tuple(slice(a, b) for a, b in idx)
+        full[sl] = _np_load(path, dtype)
+    return full
+
+
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None):
+    """Returns the number of vars actually restored (a var absent from
+    the directory is skipped — partial checkpoints are legal for
+    fine-tuning — but callers like load_checkpoint can detect a total
+    miss, e.g. a program whose auto-generated names don't line up)."""
     if vars is None:
         if main_program is None:
             main_program = default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
     scope = global_scope()
+    manifest = _read_manifest(dirname)
+    records = manifest['vars'] if manifest else {}
+    loaded = 0
     for var in vars:
         name = var.name if isinstance(var, Variable) else var
-        path = os.path.join(dirname, _safe(name) + '.npy')
-        if os.path.exists(path):
-            scope.set(name, np.load(path))
+        rec = records.get(name)
+        if rec is not None and rec.get('shards'):
+            value = _load_sharded(dirname, name, rec)
+        else:
+            path = os.path.join(dirname, _safe(name) + '.npy')
+            if not os.path.exists(path):
+                continue
+            value = (_np_load(path, rec['dtype']) if rec is not None
+                     else np.load(path))
+        if rec is not None:
+            _check_against_program(name, var, rec['shape'], rec['dtype'])
+        scope.set(name, value)
+        loaded += 1
+    return loaded
 
 
 def load_params(executor, dirname, main_program=None):
@@ -75,7 +251,8 @@ def load_params(executor, dirname, main_program=None):
 
 
 def load_persistables(executor, dirname, main_program=None):
-    load_vars(executor, dirname, main_program, predicate=is_persistable)
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_persistable)
 
 
 def load_persistables_if_exist(executor, dirname, main_program=None):
@@ -153,7 +330,13 @@ def save_checkpoint(executor, dirname, main_program=None, step=None):
 
 
 def load_checkpoint(executor, dirname, main_program=None):
-    load_persistables(executor, dirname, main_program)
+    n = load_persistables(executor, dirname, main_program)
+    if n == 0:
+        raise ValueError(
+            "checkpoint %s restored nothing — no persistable var of the "
+            "program matches a saved name (was the program rebuilt with "
+            "different auto-generated names? build it under "
+            "reset_unique_name_guard() for stable names)" % dirname)
     step_file = os.path.join(dirname, 'STEP')
     if os.path.exists(step_file):
         with open(step_file) as f:

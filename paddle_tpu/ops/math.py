@@ -124,8 +124,9 @@ def _mean(ctx, ins, attrs):
         mask = (jnp.arange(t)[None, :] < ln[:, None])
         mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
         feat = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
-        m = jnp.sum(jnp.where(mask, xf, 0.0)) / \
-            jnp.maximum(jnp.sum(ln) * feat, 1)
+        # count in f32: int32 sum(lengths)*feat overflows past 2^31 elems
+        count = jnp.sum(ln.astype(jnp.float32)) * float(feat)
+        m = jnp.sum(jnp.where(mask, xf, 0.0)) / jnp.maximum(count, 1.0)
     return out(m.astype(x.dtype).reshape((1,)))
 
 

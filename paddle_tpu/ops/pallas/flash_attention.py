@@ -11,9 +11,10 @@ VMEM scratch carries m/l/acc between k iterations).  Backward on TPU is
 a pair of Pallas kernels (dk/dv: grid (bh, nk, nq); dq: grid (bh, nq,
 nk)) recomputing p from the saved logsumexp in VMEM; off-TPU it falls
 back to a jax lax.scan flash recompute.  Causal grids skip fully-masked
-tiles.  Env gates (trace-time): PADDLE_TPU_FLASH_BWD_SCAN forces the
-scan path on TPU, PADDLE_TPU_FLASH_BWD_PALLAS runs the Pallas backward
-in interpret mode off-TPU (how CPU CI exercises the kernel path).
+tiles.  Env gates (resolved per call, part of the vjp cache key):
+PADDLE_TPU_FLASH_BWD_SCAN forces the scan path on TPU,
+PADDLE_TPU_FLASH_BWD_PALLAS runs the Pallas backward in interpret mode
+off-TPU (how CPU CI exercises the kernel path).
 
 On non-TPU backends the forward kernel runs with interpret=True, so the
 same code path is exercised by CPU CI.
@@ -425,33 +426,41 @@ def _fa_backward_pallas(causal, scale, block_q, block_k, res, do,
     return dq[:, :tq], dk[:, :tk], dv[:, :tk]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_with_lse(q, k, v, q_off, k_off, causal, scale, block_q,
-                    block_k, interpret):
+                    block_k, interpret, bwd_mode):
     """[BH, T, D] kernel entry returning (o, lse); differentiable —
     the backward folds both cotangents into one flash recompute.
-    q_off/k_off are traced int32 scalars shifting the causal mask."""
+    q_off/k_off are traced int32 scalars shifting the causal mask.
+    bwd_mode ('pallas'|'scan') is part of the vjp cache key, so the env
+    gates that select it (resolved by the caller) take effect on the
+    next call instead of silently needing jax.clear_caches()."""
     return _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
                               interpret, q_off, k_off)
 
 
 def _flash_fwd(q, k, v, q_off, k_off, causal, scale, block_q, block_k,
-               interpret):
+               interpret, bwd_mode):
     o, lse = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
                                 interpret, q_off, k_off)
     return (o, lse), (q, k, v, q_off, k_off, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, cts):
-    # env knobs are read at TRACE time (the vjp is cached under jit):
-    # toggling them mid-process needs jax.clear_caches().
-    # PADDLE_TPU_FLASH_BWD_SCAN forces the jax-scan path on TPU (A/B
-    # numerics); PADDLE_TPU_FLASH_BWD_PALLAS forces the Pallas kernels
-    # (interpret mode) off-TPU.
+def _bwd_mode_from_env(interpret):
+    """PADDLE_TPU_FLASH_BWD_SCAN forces the jax-scan path on TPU (A/B
+    numerics); PADDLE_TPU_FLASH_BWD_PALLAS forces the Pallas kernels
+    (interpret mode) off-TPU."""
+    if _env_on('PADDLE_TPU_FLASH_BWD_PALLAS'):
+        return 'pallas'
+    if interpret or _env_on('PADDLE_TPU_FLASH_BWD_SCAN'):
+        return 'scan'
+    return 'pallas'
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, bwd_mode,
+               res, cts):
     do, dlse = cts
-    force_scan = _env_on('PADDLE_TPU_FLASH_BWD_SCAN')
-    if (not interpret and not force_scan) or \
-            _env_on('PADDLE_TPU_FLASH_BWD_PALLAS'):
+    if bwd_mode == 'pallas':
         dq, dk, dv = _fa_backward_pallas(causal, scale, block_q, block_k,
                                          res, do, dlse,
                                          interpret=interpret)
@@ -499,7 +508,8 @@ def attention_with_lse(q, k, v, causal=False, scale=None, block_q=None,
         interpret = jax.default_backend() != 'tpu'
     o, lse = _flash_with_lse(qf, kf, vf, qo, ko, bool(causal),
                              float(scale), int(block_q), int(block_k),
-                             bool(interpret))
+                             bool(interpret),
+                             _bwd_mode_from_env(bool(interpret)))
     if restore is None:
         return o, lse
     b, h, tq, d = restore

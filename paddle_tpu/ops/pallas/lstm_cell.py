@@ -250,13 +250,23 @@ def _lstm_scan_core(x_tm, w, pw, interpret):
     return hs, cs
 
 
+def _residual_dtype(x_dtype):
+    """Saved forward state [T, B, 4H]+[T, B, H]x2 dominates training
+    activation HBM; bf16 callers keep bf16 residuals (the saturating
+    gate activations bound the replay error), halving that footprint.
+    f32 callers keep exact f32.  The backward upcasts before its
+    kernel, so compute stays f32 either way."""
+    return x_dtype if x_dtype == jnp.bfloat16 else jnp.float32
+
+
 def _fwd(x_tm, w, pw, interpret):
     hs, cs, gates = _lstm_forward(x_tm, w, pw, with_gates=True,
                                   interpret=interpret)  # h/c f32
     # zero-size token carries x's dtype (residuals must be jax types)
     x_tok = jnp.empty((0,), x_tm.dtype)
+    rdt = _residual_dtype(x_tm.dtype)
     return (hs.astype(x_tm.dtype), cs.astype(x_tm.dtype)), \
-        (x_tok, w, pw, hs, cs, gates)
+        (x_tok, w, pw, hs.astype(rdt), cs.astype(rdt), gates.astype(rdt))
 
 
 def _bwd(interpret, res, cts):
@@ -264,7 +274,9 @@ def _bwd(interpret, res, cts):
     # no recompute pass (cf. the scan path, which re-runs the forward)
     x_tok, w, pw, hs, cs, gates = res
     ct_h, ct_c = cts
-    dx, dw, dpw = _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c,
+    dx, dw, dpw = _lstm_backward(w, pw, hs.astype(jnp.float32),
+                                 cs.astype(jnp.float32),
+                                 gates.astype(jnp.float32), ct_h, ct_c,
                                  interpret)
     return (dx.astype(x_tok.dtype), dw.astype(w.dtype),
             dpw.astype(pw.dtype))
@@ -463,14 +475,17 @@ def _gru_fwd(x_tm, w, h0, interpret):
     hs, gates = _gru_forward(x_tm, w, h0, with_gates=True,
                              interpret=interpret)  # hs f32
     x_tok = jnp.empty((0,), x_tm.dtype)
-    return hs.astype(x_tm.dtype), (x_tok, w, h0, hs, gates)
+    rdt = _residual_dtype(x_tm.dtype)
+    return hs.astype(x_tm.dtype), (x_tok, w, h0, hs.astype(rdt),
+                                   gates.astype(rdt))
 
 
 def _gru_bwd(interpret, res, ct):
     # reverse-time BPTT kernel over the saved forward state
     x_tok, w, h0, hs, gates = res
-    dx, dw, dh0 = _gru_backward(w, h0.astype(jnp.float32), hs, gates,
-                                ct, interpret)
+    dx, dw, dh0 = _gru_backward(w, h0.astype(jnp.float32),
+                                hs.astype(jnp.float32),
+                                gates.astype(jnp.float32), ct, interpret)
     return (dx.astype(x_tok.dtype), dw.astype(w.dtype),
             dh0.astype(h0.dtype))
 

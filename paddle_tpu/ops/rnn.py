@@ -7,6 +7,8 @@ padded [B, T, D] + lengths, one lax.scan over time whose body is a single
 MXU matmul; finished rows freeze their state via masks (no reordering, no
 dynamic shapes).
 """
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -57,14 +59,26 @@ def _unreverse_and_mask(seqs, rev_idx, lengths, t):
     return out
 
 
+def _rnn_vmem_budget():
+    """VMEM bytes the BPTT kernel may claim.  TPU cores have ~16MB VMEM
+    across generations; default to 12MB (25% margin for Mosaic's own
+    temporaries).  PADDLE_TPU_RNN_VMEM_BUDGET_MB overrides for parts
+    where the margin is wrong in either direction."""
+    mb = os.environ.get('PADDLE_TPU_RNN_VMEM_BUDGET_MB')
+    try:
+        return int(float(mb) * 1024 * 1024) if mb else 12 * 1024 * 1024
+    except ValueError:
+        return 12 * 1024 * 1024
+
+
 def _pallas_rnn_fits_vmem(batch, hidden, gate_width):
     """The BPTT kernel keeps the weight block AND an equally-sized f32
     dW accumulator resident in VMEM for the whole grid, plus a few
-    [B, gate_width] tiles; past ~12MB Mosaic's scratch allocation fails,
-    so larger configs fall back to the lax.scan path."""
+    [B, gate_width] tiles; past the budget Mosaic's scratch allocation
+    fails, so larger configs fall back to the lax.scan path."""
     resident = 2 * hidden * gate_width * 4
     tiles = 8 * batch * gate_width * 4
-    return resident + tiles <= 12 * 1024 * 1024
+    return resident + tiles <= _rnn_vmem_budget()
 
 
 @register_op('lstm')

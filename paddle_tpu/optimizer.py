@@ -43,19 +43,19 @@ class Optimizer(object):
     # -- learning rate -----------------------------------------------------
     def _create_global_learning_rate(self, program):
         if isinstance(self._learning_rate, Variable):
-            self._learning_rate_map[id(program)] = self._learning_rate
+            self._learning_rate_map[program._uid] = self._learning_rate
             return
-        if id(program) in self._learning_rate_map:
+        if program._uid in self._learning_rate_map:
             return
         from .layers.tensor import create_global_var
         lr = create_global_var(
             name=unique_name("learning_rate"),
             shape=[1], value=float(self._learning_rate),
             dtype='float32', persistable=True)
-        self._learning_rate_map[id(program)] = lr
+        self._learning_rate_map[program._uid] = lr
 
     def _global_learning_rate(self, program):
-        return self._learning_rate_map[id(program)]
+        return self._learning_rate_map[program._uid]
 
     def _create_param_lr(self, param_and_grad):
         param = param_and_grad[0]

@@ -120,7 +120,7 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
                  hasattr(v, 'dtype') else str(v.dtype))
                 for d in (feed_arrays, state_rw, state_ro)
                 for n, v in sorted(d.items()))
-    key = (id(program), program.version, id(mesh), batch_axis, param_axis,
+    key = (program._uid, program.version, mesh, batch_axis, param_axis,
            tuple(getattr(f, 'name', str(f)) for f in fetch_list), donate,
            sig)
     fn = cache.get(key)

@@ -105,6 +105,144 @@ def test_checkpoint_resume(tmp_path):
                                    err_msg=n)
 
 
+def _adam_model(hidden=32, seed=7):
+    from paddle_tpu.core.program import reset_unique_name_guard
+    with reset_unique_name_guard():  # stable names across rebuilds
+        main = fluid.Program()
+        startup = fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.fc(input=x, size=hidden, act='relu')
+            pred = fluid.layers.fc(input=h, size=1, act=None)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.AdamOptimizer(
+                learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _dist_batches(n, bs=16):
+    rng = np.random.RandomState(3)
+    w = rng.randn(16, 1).astype('float32')
+    return [{'x': (xb := rng.randn(bs, 16).astype('float32')),
+             'y': xb @ w} for _ in range(n)]
+
+
+def test_sharded_checkpoint_resume_exact(tmp_path):
+    """VERDICT r2 #3: under an fsdp mesh, save_checkpoint writes per-shard
+    files + PartitionSpecs; restoring into a fresh scope/executor under
+    the mesh reassembles sharded arrays and the next-step losses match a
+    never-interrupted run exactly."""
+    import glob
+
+    import jax
+    import pytest
+
+    from paddle_tpu.parallel import api
+    from paddle_tpu.parallel.data_parallel import DataParallel
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    batches = _dist_batches(4)
+
+    def run(n_steps, start=0, exe=None, dp=None, main=None, loss=None):
+        if exe is None:
+            main, startup, loss = _adam_model()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            mesh = api.make_mesh((8,), ('fsdp',))
+            dp = DataParallel(exe, mesh, axis='fsdp', fsdp_axis='fsdp')
+        losses = [float(np.ravel(dp.run(main, feed=f,
+                                        fetch_list=[loss])[0])[0])
+                  for f in batches[start:start + n_steps]]
+        return losses, exe, dp, main, loss
+
+    # A: uninterrupted 4 steps
+    losses_a, *_ = run(4)
+
+    # B: 2 steps, checkpoint under the mesh
+    _, exe_b, dp_b, main_b, loss_b = run(2)
+    ckpt = str(tmp_path / 'sharded_ckpt')
+    with api.mesh_guard(dp_b.mesh):
+        io.save_checkpoint(exe_b, ckpt, main_b, step=2)
+    # per-shard layout actually used (fsdp shards the [16,32] fc weight)
+    assert glob.glob(ckpt + '/*.shard0.npy'), "no per-shard files written"
+    manifest = io._read_manifest(ckpt)
+    assert any(r.get('spec') for r in manifest['vars'].values())
+    # Adam moments are persistable and must be in the checkpoint
+    assert any('moment' in n or 'beta' in n for n in manifest['vars'])
+
+    # C: fresh everything, restore under the mesh, continue steps 3-4
+    main_c, startup_c, loss_c = _adam_model()
+    exe_c = fluid.Executor(fluid.CPUPlace())
+    exe_c.run(startup_c)
+    mesh = api.make_mesh((8,), ('fsdp',))
+    with api.mesh_guard(mesh):
+        step = io.load_checkpoint(exe_c, ckpt, main_c)
+    assert step == 2
+    # restored params landed sharded on the mesh, not as replicated host
+    scope = fluid.global_scope()
+    sharded = [n for n, r in manifest['vars'].items() if r.get('spec')]
+    val = scope.find_var(sharded[0])
+    assert isinstance(val, jax.Array) and not val.sharding.is_fully_replicated
+    dp_c = DataParallel(exe_c, mesh, axis='fsdp', fsdp_axis='fsdp')
+    losses_c = [float(np.ravel(dp_c.run(main_c, feed=f,
+                                        fetch_list=[loss_c])[0])[0])
+                for f in batches[2:4]]
+    np.testing.assert_array_equal(losses_c, losses_a[2:4])
+
+
+def test_sharded_checkpoint_loads_without_mesh(tmp_path):
+    """A sharded checkpoint read with no mesh active assembles the full
+    numpy value from its shard files."""
+    import jax
+    import pytest
+
+    from paddle_tpu.parallel import api
+    from paddle_tpu.parallel.data_parallel import DataParallel
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    main, startup, loss = _adam_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    mesh = api.make_mesh((8,), ('fsdp',))
+    dp = DataParallel(exe, mesh, axis='fsdp', fsdp_axis='fsdp')
+    dp.run(main, feed=_dist_batches(1)[0], fetch_list=[loss])
+    scope = fluid.global_scope()
+    want = {p.name: np.asarray(scope.find_var(p.name))
+            for p in main.global_block().all_parameters()}
+    ckpt = str(tmp_path / 'ckpt_nomesh')
+    io.save_checkpoint(exe, ckpt, main)
+    for n in want:
+        scope.set(n, np.zeros_like(want[n]))
+    io.load_checkpoint(exe, ckpt, main)  # no mesh_guard
+    for n, v in want.items():
+        got = scope.find_var(n)
+        assert isinstance(got, np.ndarray)
+        np.testing.assert_array_equal(got, v, err_msg=n)
+
+
+def test_checkpoint_mismatch_raises(tmp_path):
+    """Weak r2 #7: restoring into a changed program fails loudly (shape
+    manifest check) instead of silently corrupting the scope."""
+    import pytest
+    main, startup, loss = _adam_model(hidden=32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    _ = exe.run(main, feed=_dist_batches(1, bs=4)[0], fetch_list=[loss])
+    ckpt = str(tmp_path / 'ckpt_mismatch')
+    io.save_checkpoint(exe, ckpt, main)
+
+    # same build order -> same auto param names, different hidden size
+    main2, startup2, _loss2 = _adam_model(hidden=64)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    with pytest.raises(ValueError, match='declares'):
+        io.load_checkpoint(exe2, ckpt, main2)
+
+
 def test_embedding_lookup_and_padding_idx():
     """lookup_table forward parity (operators/lookup_table_op.cc)."""
     from op_test import run_op
