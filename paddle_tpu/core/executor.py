@@ -322,6 +322,7 @@ class Executor(object):
             place = place[0]
         self.place = place if place is not None else default_place()
         self._cache = {}
+        self._mesh_op_cache = {}
         self._step = 0
 
     # ------------------------------------------------------------------
@@ -350,6 +351,16 @@ class Executor(object):
         block = program.global_block()
 
         dev = self.place.jax_device()
+        # A program with a parallel_do op lowers to a shard_map over the
+        # active mesh; its jit then spans the mesh's devices, so every
+        # argument must be placed replicated on the mesh (the reference
+        # analogue: the host drives the program, only parallel_do fans
+        # out to places).  Single-device placement would make jit reject
+        # the mixed device sets.
+        mesh = self._active_mesh(program)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            dev = NamedSharding(mesh, PartitionSpec())
         feed_arrays = {}
         for name, value in feed.items():
             var = block.vars.get(name)
@@ -359,16 +370,22 @@ class Executor(object):
         # the computation to `place` without a jax.default_device context
         # (which defeats jit's C++ fast-path dispatch — measured 9.7s/step
         # vs 60ms on a tunneled v5e).
-        feed_arrays = {k: (v if isinstance(v, jax.Array)
+        feed_arrays = {k: (v if isinstance(v, jax.Array) and mesh is None
                            else jax.device_put(v, dev))
                        for k, v in feed_arrays.items()}
 
         plan = self._get_plan(program, block, scope, feed_arrays,
-                              tuple(fetch_names), use_program_cache)
+                              tuple(fetch_names), use_program_cache,
+                              mesh=mesh)
         (fn, _raw, state_rw_names, state_ro_names) = plan
 
         state_rw = {n: scope.get(n) for n in state_rw_names}
         state_ro = {n: scope.get(n) for n in state_ro_names}
+        if mesh is not None:
+            state_rw = {n: jax.device_put(v, dev)
+                        for n, v in state_rw.items()}
+            state_ro = {n: jax.device_put(v, dev)
+                        for n, v in state_ro.items()}
         rng_key = jax.device_put(self._rng_key(program), dev)
         self._step += 1
 
@@ -381,6 +398,23 @@ class Executor(object):
         return fetches
 
     # ------------------------------------------------------------------
+    def _active_mesh(self, program):
+        """The current mesh_guard mesh, when `program` contains an op
+        that fans out over it (parallel_do) and the mesh is >1 device."""
+        key = (program._uid, program.version)
+        has = self._mesh_op_cache.get(key)
+        if has is None:
+            has = any(op.type == 'parallel_do'
+                      for b in program.blocks for op in b.ops)
+            self._mesh_op_cache[key] = has
+        if not has:
+            return None
+        from ..parallel import api as _papi
+        mesh = _papi.current_mesh()
+        if mesh is None or mesh.devices.size <= 1:
+            return None
+        return mesh
+
     def _rng_key(self, program):
         seed = program.random_seed
         if seed == 0:
@@ -416,14 +450,17 @@ class Executor(object):
         return tuple(sorted(rw)), tuple(sorted(ro)), tuple(sorted(out))
 
     def _get_plan(self, program, block, scope, feed_arrays, fetch_names,
-                  use_cache):
+                  use_cache, mesh=None):
         feed_sig = tuple(
             (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
             for n in sorted(feed_arrays))
         state_rw_names, state_ro_names, state_out_names = \
             self._analyze_state(program, scope, set(feed_arrays))
+        # mesh participates: a parallel_do program traced under a mesh
+        # embeds that mesh's shard_map in the compiled step
         key = (program._uid, program.version, feed_sig, fetch_names,
-               state_rw_names, state_ro_names, state_out_names, id(scope))
+               state_rw_names, state_ro_names, state_out_names, id(scope),
+               mesh)
         if use_cache and key in self._cache:
             return self._cache[key]
 
@@ -462,6 +499,123 @@ class Executor(object):
         if use_cache:
             self._cache[key] = plan
         return plan
+
+    def run_steps(self, program=None, feed=None, fetch_list=None,
+                  scope=None, repeat=None, return_numpy=True):
+        """Run K training steps as ONE compiled XLA computation — a
+        lax.scan over the step function with the persistable state as
+        donated carry.
+
+        TPU-native executor extension (no reference counterpart): over a
+        network-attached accelerator each run() costs a host dispatch
+        round trip; scanning K steps on-device amortizes it to one.  The
+        per-step PRNG chain folds (seed, global_step) exactly like run(),
+        so K calls of run() and one run_steps(K) produce identical
+        numerics, dropout streams included.
+
+        :param feed: list of K feed dicts (stacked on the device), or a
+            single feed dict with ``repeat=K`` to reuse one device-staged
+            batch for every step (benchmark mode — no re-staging).
+        :param fetch_list: fetched per step; returns [K, ...]-stacked
+            arrays, one per fetch.
+        """
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        fetch_names = tuple(
+            f.name if isinstance(f, Variable) else str(f)
+            for f in (fetch_list or []))
+        block = program.global_block()
+
+        if isinstance(feed, dict):
+            if not repeat:
+                raise ValueError("run_steps with a single feed dict "
+                                 "needs repeat=K")
+            feeds, k = [feed], int(repeat)
+        else:
+            feeds, k = list(feed), len(feed)
+            if repeat:
+                raise ValueError("repeat= only combines with a single "
+                                 "feed dict")
+            if k == 0:
+                return []
+        stacked = len(feeds) > 1
+
+        dev = self.place.jax_device()
+        feed0 = {}
+        for name, value in feeds[0].items():
+            var = block.vars.get(name)
+            feed0.update(_to_feed_arrays(name, value, var))
+        feed0 = {n: (v if isinstance(v, jax.Array)
+                     else jax.device_put(v, dev))
+                 for n, v in feed0.items()}
+
+        fn_plan = self._get_plan(program, block, scope, feed0,
+                                 fetch_names, True)
+        _fn, raw_fn, rw_names, ro_names = fn_plan
+
+        mkey = ('multi', program._uid, program.version, k, stacked,
+                fetch_names,
+                tuple((n, feed0[n].shape, str(feed0[n].dtype))
+                      for n in sorted(feed0)), id(scope),
+                rw_names, ro_names)
+        multi = self._cache.get(mkey)
+        if multi is None:
+            def multi_fn(feed_one, xs_feeds, state_rw, state_ro, key0,
+                         t0):
+                def body(carry, xs_t):
+                    rw, t = carry
+                    f_t = xs_t if stacked else feed_one
+                    key = jax.random.fold_in(key0, t)
+                    fetches, new_state = raw_fn(f_t, rw, state_ro, key)
+                    new_rw = {n: new_state[n] for n in rw_names
+                              if n in new_state}
+                    extra = {n: v for n, v in new_state.items()
+                             if n not in new_rw}
+                    return (new_rw, t + 1), (tuple(fetches), extra)
+
+                (rw_f, _), (ys, extras) = jax.lax.scan(
+                    body, (state_rw, t0), xs_feeds,
+                    length=None if stacked else k)
+                last_extra = jax.tree_util.tree_map(lambda a: a[-1],
+                                                    extras)
+                return ys, rw_f, last_extra
+
+            multi = jax.jit(multi_fn, donate_argnums=(2,))
+            self._cache[mkey] = multi
+
+        xs = None
+        if stacked:
+            cols = {}
+            for f in feeds:
+                fa = {}
+                for name, value in f.items():
+                    var = block.vars.get(name)
+                    fa.update(_to_feed_arrays(name, value, var))
+                for n, v in fa.items():
+                    cols.setdefault(n, []).append(np.asarray(v))
+            xs = {n: jax.device_put(np.stack(vs), dev)
+                  for n, vs in cols.items()}
+
+        state_rw = {n: scope.get(n) for n in rw_names}
+        state_ro = {n: scope.get(n) for n in ro_names}
+        seed = program.random_seed
+        if seed == 0:
+            seed = id(self) % (2**31)
+        key0 = jax.device_put(jax.random.PRNGKey(seed), dev)
+        t0 = jnp.asarray(self._step, jnp.int32)
+
+        ys, rw_f, last_extra = multi(feed0, xs, state_rw, state_ro,
+                                     key0, t0)
+        self._step += k
+        for n, v in rw_f.items():
+            scope.set(n, v)
+        for n, v in last_extra.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(y) for y in ys]
+        return list(ys)
 
     def _compile_common(self, program, feed, fetch_list, scope):
         if program is None:

@@ -549,27 +549,49 @@ class ConditionalBlock(object):
 
 
 class ParallelDo(object):
-    """fluid.layers.ParallelDo parity shell.  The reference splits the
-    batch across GPU places and runs the sub-block per device; the TPU
-    -native equivalent is mesh data parallelism (parallel/data_parallel
-    .py), so this guard simply builds the block inline — running it under
-    DataParallel shards it for real."""
+    """fluid.layers.ParallelDo (operators/parallel_do_op.cc): split the
+    batch across places, run the sub-block per place, concatenate the
+    outputs along dim 0 (gradients accumulate across places).
 
-    def __init__(self, places, name=None):
-        import warnings
-        warnings.warn(
-            "ParallelDo builds its body inline (single-device numerics); "
-            "for real multi-device execution run the program with "
-            "parallel.DataParallel / run_sharded over a Mesh",
-            stacklevel=2)
+    TPU-native execution: the body is captured as a sub-block; with a
+    mesh_guard active the parallel_do op runs it batch-sharded via
+    shard_map over the mesh (each member computes its shard, outputs
+    concatenate over the mesh axis, and XLA inserts the grad psum when
+    differentiated).  With no mesh the body runs inline on the full
+    batch — the places=1 semantics.  `places` (get_places) is kept for
+    API parity; the actual device set is the mesh's."""
+
+    def __init__(self, places=None, use_nccl=False, name=None):
         self.helper = LayerHelper('parallel_do', name=name)
+        self.places = places
+        self._inputs = []
         self._outputs = []
 
     @contextlib.contextmanager
     def do(self):
-        yield
+        prog = self.helper.main_program
+        sub_block = prog.create_block()
+        try:
+            yield
+        except Exception:
+            prog.rollback()
+            raise
+        prog.rollback()
+        self.helper.append_op(
+            type='parallel_do',
+            inputs={'X': list(self._inputs)},
+            outputs={'Out': list(self._outputs)},
+            attrs={'sub_block': sub_block.idx,
+                   'split_inputs': [v.name for v in self._inputs],
+                   'output_names': [v.name for v in self._outputs]},
+            infer_shape=False)
 
     def read_input(self, x):
+        """Declare x as batch-split across places (reference: creates the
+        per-place slice; here the op's kernel rebinds the name to the
+        local shard inside shard_map)."""
+        if all(v.name != x.name for v in self._inputs):
+            self._inputs.append(x)
         return x
 
     def write_output(self, o):

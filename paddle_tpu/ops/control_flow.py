@@ -125,6 +125,85 @@ def _conditional_block(ctx, ins, attrs):
     return {'__env_update__': [update]}
 
 
+@register_op('parallel_do', needs_env=True)
+def _parallel_do(ctx, ins, attrs):
+    """operators/parallel_do_op.cc: batch-split the declared inputs, run
+    the sub-block per mesh member via shard_map, concatenate the declared
+    outputs along dim 0.  Differentiable: shard_map's transpose inserts
+    the cross-member grad psum for replicated reads (params), matching
+    the reference's cross-place gradient accumulation.  With no mesh (or
+    a 1-device mesh) the body runs inline on the full batch."""
+    import numpy as np
+
+    sub_idx = int(attrs['sub_block'])
+    split_names = list(attrs['split_inputs'])
+    out_names = list(attrs['output_names'])
+    env = ins['__env__'][0]
+
+    from ..parallel import api as papi
+    mesh = papi.current_mesh()
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    if n_dev == 1:
+        env2 = dict(env)
+        ctx.run_block(sub_idx, env2)
+        update = {n: env2[n] for n in out_names if n in env2}
+        return {'__env_update__': [update]}
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = attrs.get('mesh_axis') or mesh.axis_names[0]
+    size = mesh.shape[axis]
+    read, _written = _block_rw(ctx.program, sub_idx)
+
+    def _is_arr(v):
+        return isinstance(v, jnp.ndarray) or hasattr(v, 'dtype')
+
+    split = {}
+    for n in split_names:
+        v = env[n]
+        if v.shape[0] % size:
+            raise ValueError(
+                "parallel_do input %r batch %d is not divisible by the "
+                "%d members of mesh axis %r" % (n, v.shape[0], size, axis))
+        split[n] = v
+    repl = {n: env[n] for n in sorted(read)
+            if n in env and n not in split and _is_arr(env[n])}
+    key = ctx.rng()
+
+    block = ctx.program.blocks[sub_idx]
+
+    def run_body(split_d, repl_d, k):
+        from ..core.executor import _run_ops
+        sub_ctx = ctx.sub_context(block)
+        sub_ctx.rng_key = k
+        env2 = {}
+        env2.update(repl_d)
+        env2.update(split_d)
+        _run_ops(block.ops, env2, sub_ctx)
+        # rank-0 outputs concat like the reference's per-place scalars:
+        # lift to (1,) so the axis concat yields [n_places]
+        return {n: (env2[n].reshape((1,)) if env2[n].ndim == 0
+                    else env2[n]) for n in out_names}
+
+    def run_local(split_d, repl_d, k):
+        # distinct randomness per place: fold the member index into the
+        # key, else every shard would draw the same dropout masks
+        return run_body(split_d, repl_d,
+                        jax.random.fold_in(k, jax.lax.axis_index(axis)))
+
+    out_struct = jax.eval_shape(run_body, split, repl, key)
+    in_specs = ({n: P(axis, *([None] * (v.ndim - 1)))
+                 for n, v in split.items()},
+                {n: P() for n in repl}, P())
+    out_specs = {n: P(axis, *([None] * (s.ndim - 1)))
+                 for n, s in out_struct.items()}
+    fn = shard_map(run_local, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    update = fn(split, repl, key)
+    return {'__env_update__': [update]}
+
+
 @register_op('recurrent', needs_env=True)
 def _recurrent(ctx, ins, attrs):
     """StaticRNN/DynamicRNN: lax.scan over the time axis.

@@ -81,3 +81,70 @@ def test_dropout_train_vs_test():
     out2, = exe.run(test_prog, feed={'x': xv}, fetch_list=[d.name])
     # reference dropout_op.h is_test path: Out = X * (1 - p)
     np.testing.assert_allclose(out2, xv * 0.5)
+
+
+def test_run_steps_matches_run_loop():
+    """run_steps(K) (one lax.scan-compiled XLA program, donated state)
+    is numerics-identical to K successive run() calls — same PRNG chain
+    (dropout included), same optimizer state evolution."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 17
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[8],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                h = fluid.layers.fc(input=x, size=16, act='relu')
+                h = fluid.layers.dropout(x=h, dropout_prob=0.3)
+                p = fluid.layers.fc(input=h, size=1)
+                loss = fluid.layers.mean(
+                    x=fluid.layers.square_error_cost(input=p, label=y))
+                fluid.optimizer.AdamOptimizer(
+                    learning_rate=0.01).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(8)
+    w = rng.randn(8, 1).astype('float32')
+    batches = [{'x': (xb := rng.randn(8, 8).astype('float32')),
+                'y': xb @ w} for _ in range(4)]
+
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want = [float(np.ravel(exe.run(main, feed=f, fetch_list=[loss])[0])[0])
+            for f in batches]
+    params_want = {p.name: np.asarray(fluid.global_scope().find_var(p.name))
+                   for p in main.global_block().all_parameters()}
+
+    # stacked-feeds mode
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run_steps(main, feed=batches, fetch_list=[loss])[0]
+    np.testing.assert_allclose(np.ravel(got), want, rtol=1e-5, atol=1e-6)
+    for n, v in params_want.items():
+        np.testing.assert_allclose(
+            np.asarray(fluid.global_scope().find_var(n)), v,
+            rtol=1e-5, atol=1e-6, err_msg=n)
+
+    # repeat-one-feed mode: equals 4 runs of the same batch
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want_rep = [float(np.ravel(exe.run(main, feed=batches[0],
+                                       fetch_list=[loss])[0])[0])
+                for _ in range(4)]
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got_rep = exe.run_steps(main, feed=batches[0], fetch_list=[loss],
+                            repeat=4)[0]
+    np.testing.assert_allclose(np.ravel(got_rep), want_rep, rtol=1e-5,
+                               atol=1e-6)
