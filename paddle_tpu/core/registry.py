@@ -11,6 +11,7 @@ to PRNG keys and the interpreter (for ops with sub-blocks).
 """
 
 _OP_REGISTRY = {}
+_CALLED = set()  # op types fetched for execution (coverage meta-test)
 
 
 class OpImpl(object):
@@ -39,6 +40,7 @@ def get_op_impl(type):
     if impl is None:
         raise NotImplementedError(
             "no TPU implementation registered for op %r" % type)
+    _CALLED.add(type)
     return impl
 
 
@@ -48,3 +50,11 @@ def has_op(type):
 
 def registered_ops():
     return sorted(_OP_REGISTRY)
+
+
+def called_ops():
+    """Op types actually fetched for execution in this process — the
+    registry-coverage meta-test (tests/test_zz_op_coverage.py) diffs this
+    against registered_ops() at the end of a full suite run, so a newly
+    registered op with no test fails CI instead of rotting silently."""
+    return set(_CALLED)

@@ -74,6 +74,28 @@ def test_smooth_l1_loss():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_smooth_l1_reference_name_and_weights():
+    """The reference op name (smooth_l1_op.cc) with Inside/OutsideWeight
+    and a non-unit sigma; also checks the Diff output."""
+    x = rng.randn(4, 6).astype('float32')
+    y = rng.randn(4, 6).astype('float32')
+    iw = rng.rand(4, 6).astype('float32')
+    ow = rng.rand(4, 6).astype('float32')
+    sigma = 3.0
+    got = run_op('smooth_l1',
+                 {'X': x, 'Y': y, 'InsideWeight': iw, 'OutsideWeight': ow},
+                 {'sigma': sigma})
+    s2 = sigma * sigma
+    d = (x - y) * iw
+    ad = np.abs(d)
+    elem = np.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2) * ow
+    np.testing.assert_allclose(np.asarray(got['Out'][0]),
+                               elem.sum(axis=1, keepdims=True),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got['Diff'][0]), d,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_hinge_loss():
     logits = rng.randn(7, 1).astype('float32')
     lab = rng.randint(0, 2, (7, 1)).astype('float32')
