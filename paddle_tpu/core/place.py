@@ -22,16 +22,19 @@ class Place(object):
         return hash((type(self).__name__, self.device_id))
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device, falling back to the default
-        backend when the requested platform is absent (e.g. asking for
-        TPUPlace on a CPU-only host during tests)."""
+        """Resolve to a concrete LOCAL jax.Device, falling back to the
+        default backend when the requested platform is absent (e.g.
+        asking for TPUPlace on a CPU-only host during tests).  Local
+        devices only: in a multi-process (distributed.launch) run,
+        jax.devices() leads with process 0's devices, which other
+        processes cannot place data on."""
         if self._platform is not None:
             try:
-                devs = jax.devices(self._platform)
+                devs = jax.local_devices(backend=self._platform)
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices()
         else:
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

@@ -85,6 +85,43 @@ def param_sharding(mesh, axis, shape):
     return NamedSharding(mesh, P(*spec))
 
 
+def _multiprocess(mesh):
+    """True when the mesh spans devices of more than one OS process
+    (multi-host / multi-controller run via distributed.launch)."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def _place(v, sh):
+    """Put a (host or device) value onto the mesh with sharding `sh`.
+
+    Single-process: plain device_put.  Multi-process: every process holds
+    the same GLOBAL value (the launch protocol feeds each process the
+    full batch deterministically) and materializes only its addressable
+    shards via make_array_from_callback — device_put cannot target
+    non-addressable devices.  Values already sharded correctly pass
+    through untouched."""
+    if isinstance(v, jax.Array) and v.sharding == sh:
+        return v
+    if _multiprocess(sh.mesh):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            return v  # already global; jit reshards if needed
+        host = np.asarray(v)
+        return jax.make_array_from_callback(host.shape, sh,
+                                            lambda idx: host[idx])
+    return jax.device_put(v, sh)
+
+
+def _fetch_np(v):
+    """Fetched value -> numpy, tolerating multi-process global arrays:
+    replicated fetches read a local shard; sharded fetches allgather."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        if v.sharding.is_fully_replicated:
+            return np.asarray(v.addressable_data(0))
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+    return np.asarray(v)
+
+
 def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
                 param_axis=None, donate=True):
     """Execute one step of `program` SPMD over the current mesh.
@@ -133,13 +170,13 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
 
     # stage args onto the mesh explicitly: jit refuses committed
     # single-device arrays whose placement disagrees with in_shardings
-    feed_arrays = {n: jax.device_put(v, feed_sh[n])
+    feed_arrays = {n: _place(v, feed_sh[n])
                    for n, v in feed_arrays.items()}
-    state_rw = {n: jax.device_put(v, rw_sh[n])
+    state_rw = {n: _place(v, rw_sh[n])
                 for n, v in state_rw.items()}
-    state_ro = {n: jax.device_put(v, ro_sh[n])
+    state_ro = {n: _place(v, ro_sh[n])
                 for n, v in state_ro.items()}
-    rng_key = jax.device_put(rng_key, key_sh)
+    rng_key = _place(rng_key, key_sh)
     # write staged read-only state back so later steps find it already on
     # the mesh and the device_puts above become no-ops
     for n, v in state_ro.items():
@@ -149,4 +186,4 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
     exe._step += 1  # advance the PRNG chain (dropout etc.) across steps
     for n, v in new_state.items():
         scope.set(n, v)
-    return [np.asarray(v) for v in fetches]
+    return [_fetch_np(v) for v in fetches]
