@@ -1,0 +1,105 @@
+"""Serving benchmark (VERDICT r2 #7): latency + throughput of the saved
+StableHLO ResNet-50 inference artifact — the capi deployment use case
+(reference paddle/capi: load once, predict many).
+
+Batch-1 latency is a per-call round trip (on the axon-tunneled bench box
+this includes ~110ms tunnel RTT — noted in the JSON); throughput chains
+calls through a data dependency and syncs once, so it measures the chip,
+not the tunnel.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from common import on_tpu  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import serving
+    from paddle_tpu.models import resnet
+
+    tpu = on_tpu()
+    if tpu:
+        hw, depth, classes = 224, 50, 1000
+        lat_calls, thr_chain = 30, 30
+    else:  # CPU smoke: same path, tiny shapes
+        hw, depth, classes = 64, 18, 100
+        lat_calls, thr_chain = 5, 5
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img, label, prediction, avg_cost, acc = resnet.build_imagenet(
+            depth=depth, num_classes=classes, image_shape=(hw, hw, 3),
+            dtype='bfloat16', layout='NHWC')
+    place = fluid.TPUPlace(0) if tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    results = []
+    for batch, mode in ((1, 'latency'), (8, 'latency'), (8, 'throughput'),
+                        (64, 'throughput')):
+        path = os.path.join(tempfile.mkdtemp(), 'resnet_b%d.hlo' % batch)
+        serving.export_inference(path, {'img': (batch, hw, hw, 3)},
+                                 [prediction], executor=exe,
+                                 main_program=main_prog)
+        server = serving.InferenceServer(path)
+        x = rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)
+        np.asarray(server.predict({'img': x})[0])  # warm the executable
+
+        if mode == 'latency':
+            times = []
+            for _ in range(lat_calls):
+                t0 = time.perf_counter()
+                np.asarray(server.predict({'img': x})[0])  # full sync
+                times.append(time.perf_counter() - t0)
+            r = {"metric": "resnet%d_serving_latency_ms_b%d"
+                           % (depth, batch),
+                 "value": round(float(np.median(times)) * 1e3, 2),
+                 "unit": "ms", "dtype": "bfloat16"}
+            if tpu:
+                r["note"] = "per-call round trip incl. axon tunnel RTT"
+        else:
+            # chain calls through a data dependency inside ONE jit (each
+            # feed depends on the previous logits) and sync once: on the
+            # tunneled bench box per-call dispatch costs an RTT, which
+            # would measure the network, not the chip
+            from jax import export as jax_export
+            with open(path, 'rb') as f:
+                exported = jax_export.deserialize(f.read())
+            key = jax.random.PRNGKey(0)
+
+            def chain(x0):
+                def body(_, x):
+                    out = exported.call({'img': x}, key)[0]
+                    return x + 0.0 * out.astype(jnp.float32).sum()
+                return jax.lax.fori_loop(0, thr_chain, body, x0)
+
+            chain_j = jax.jit(chain)
+            xj = jax.device_put(x, place.jax_device())
+            np.asarray(chain_j(xj))  # compile
+            t0 = time.perf_counter()
+            np.asarray(chain_j(xj))
+            dt = time.perf_counter() - t0
+            r = {"metric": "resnet%d_serving_throughput_img_s_b%d"
+                           % (depth, batch),
+                 "value": round(batch * thr_chain / dt, 2),
+                 "unit": "img/s", "dtype": "bfloat16"}
+        print(json.dumps(r))
+        results.append(r)
+    return results
+
+
+if __name__ == '__main__':
+    main()

@@ -76,9 +76,13 @@ def load_exported(path):
     with open(path, 'rb') as f:
         exported = jax_export.deserialize(f.read())
 
+    # cache the jit: bare exported.call re-traces (and re-compiles) on
+    # every invocation — measured 4s/call vs 2ms for ResNet-50 b8
+    call = jax.jit(exported.call)
+
     def run(feed):
         key = jax.random.PRNGKey(0)
-        return exported.call(feed, key)
+        return call(feed, key)
 
     return run
 
