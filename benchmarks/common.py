@@ -31,23 +31,33 @@ def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
     exe.run(startup)
 
     dev = place.jax_device()
-    feed = {k: jax.device_put(v, dev) for k, v in feed_fn().items()}
 
-    for _ in range(warmup):
-        out = exe.run(program, feed=feed, fetch_list=[loss])
+    def stage(f):
+        return {k: (tuple(v) if isinstance(v, tuple)
+                    else jax.device_put(v, dev)) for k, v in f.items()}
+
+    feed = stage(feed_fn())
+
+    # K steps as one compiled lax.scan (Executor.run_steps) sampled 3x,
+    # median reported: per-step dispatch over the tunneled TPU costs a
+    # round trip, and single samples carry +-30% tunnel noise
+    out = exe.run_steps(program, feed=feed, fetch_list=[loss],
+                        repeat=steps, return_numpy=False)  # compile+warm
     np.asarray(out[0])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = exe.run(program, feed=feed, fetch_list=[loss],
-                      return_numpy=False)
-    val = float(np.asarray(out[0]).ravel()[0])
-    dt = time.perf_counter() - t0
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = exe.run_steps(program, feed=feed, fetch_list=[loss],
+                            repeat=steps, return_numpy=False)
+        vals = np.asarray(out[0])
+        samples.append(unit_count * steps / (time.perf_counter() - t0))
+    val = float(vals.ravel()[-1])
     assert np.isfinite(val), "loss went non-finite"
 
     result = {
         "metric": metric,
-        "value": round(unit_count * steps / dt, 2),
+        "value": round(float(np.median(samples)), 2),
+        "samples": [round(s, 1) for s in samples],
     }
     if dtype:
         # structured workload marker: keeps the metric key stable across
