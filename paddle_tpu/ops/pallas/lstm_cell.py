@@ -27,14 +27,38 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ['lstm_scan', 'gru_scan']
+__all__ = ['lstm_scan', 'gru_scan', 'pick_batch_tile']
+
+
+def pick_batch_tile(b, hidden, gate_width, budget):
+    """Largest batch tile bt (a divisor of b, sublane-aligned when
+    possible) whose BPTT working set — resident weight + f32 dW
+    accumulator + ~8 per-step [bt, gate_width] tiles — fits `budget`
+    bytes of VMEM.  Returns None when even the smallest tile doesn't
+    fit.  Tiling the batch is what lets large-batch training keep the
+    fused kernel instead of falling back to lax.scan."""
+    resident = 2 * hidden * gate_width * 4
+
+    def fits(bt):
+        return resident + 8 * bt * gate_width * 4 <= budget
+
+    divs = [d for d in range(b, 0, -1) if b % d == 0]
+    # prefer sublane-aligned tiles, but only over unaligned ones when an
+    # aligned candidate actually fits
+    for bt in divs:
+        if (bt % 8 == 0 or bt == b) and fits(bt):
+            return bt
+    for bt in divs:
+        if fits(bt):
+            return bt
+    return None
 
 
 def _lstm_kernel(x_ref, w_ref, pw_ref, o_h_ref, o_c_ref, *o_g_and_scr,
                  hidden, with_gates):
     o_g_ref = o_g_and_scr[0] if with_gates else None
     h_scr, c_scr = o_g_and_scr[-2:]
-    t = pl.program_id(0)
+    t = pl.program_id(1)  # grid = (batch_tiles, time); time innermost
 
     @pl.when(t == 0)
     def _init():
@@ -65,18 +89,25 @@ def _lstm_kernel(x_ref, w_ref, pw_ref, o_h_ref, o_c_ref, *o_g_and_scr,
 
 def _lstm_bwd_kernel(gates_ref, c_ref, cprev_ref, hprev_ref, cth_ref,
                      ctc_ref, w_ref, pw_ref, dx_ref, dw_ref, dpw_ref,
-                     dh_scr, dc_scr, dw_scr, dpw_scr, *, hidden, nt):
-    """Reverse-time BPTT: grid step idx processes t = nt-1-idx with the
-    (dh, dc) chain and the dW/dpw accumulators living in VMEM — no
-    forward recompute (gates/h/c come from the forward kernel)."""
-    idx = pl.program_id(0)
+                     dh_scr, dc_scr, dw_scr, dpw_scr, *, hidden, nt, nb):
+    """Reverse-time BPTT over grid (batch_tiles, time): time step idx
+    processes t = nt-1-idx with the (dh, dc) chain and the dW/dpw
+    accumulators living in VMEM — no forward recompute (gates/h/c come
+    from the forward kernel).  The chain scratches reset per batch tile;
+    dW/dpw accumulate across ALL tiles and write out on the last grid
+    step."""
+    bi = pl.program_id(0)
+    idx = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(bi == 0, idx == 0))
+    def _init_acc():
+        dw_scr[...] = jnp.zeros_like(dw_scr[...])
+        dpw_scr[...] = jnp.zeros_like(dpw_scr[...])
 
     @pl.when(idx == 0)
     def _init():
         dh_scr[...] = jnp.zeros_like(dh_scr[...])
         dc_scr[...] = jnp.zeros_like(dc_scr[...])
-        dw_scr[...] = jnp.zeros_like(dw_scr[...])
-        dpw_scr[...] = jnp.zeros_like(dpw_scr[...])
 
     g = gates_ref[0]                      # [B, 4H] f32 (i, f, cand, o)
     i = g[:, :hidden]
@@ -114,7 +145,7 @@ def _lstm_bwd_kernel(gates_ref, c_ref, cprev_ref, hprev_ref, cth_ref,
         preferred_element_type=jnp.float32)
     dc_scr[...] = dc * f + dgi * pw[0:1, :] + dgf * pw[1:2, :]
 
-    @pl.when(idx == nt - 1)
+    @pl.when(jnp.logical_and(bi == nb - 1, idx == nt - 1))
     def _finish():
         dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
         dpw_ref[...] = dpw_scr[...].astype(dpw_ref.dtype)
@@ -159,43 +190,51 @@ def lstm_scan(x_tm, w, pw=None, interpret=None):
     return _lstm_scan_core(x_tm, w, pw, bool(interpret))
 
 
+def _batch_tile(b, hidden, gate_width):
+    from ..rnn import _rnn_vmem_budget
+    bt = pick_batch_tile(b, hidden, gate_width, _rnn_vmem_budget())
+    return bt if bt is not None else b
+
+
 def _lstm_forward(x_tm, w, pw, with_gates, interpret):
     """with_gates=True also emits the f32 post-activation gates the BPTT
     kernel replays; the primal (no-grad) path skips that HBM write."""
     t, b, four_h = x_tm.shape
     hidden = four_h // 4
+    bt = _batch_tile(b, hidden, four_h)
+    nb = b // bt
     kernel = functools.partial(_lstm_kernel, hidden=hidden,
                                with_gates=with_gates)
+    tm = lambda j, i: (i, j, 0)  # [T, B, X] blocks over (batch, time)
     # the grad path keeps h/c residuals f32 so the BPTT replay sees the
     # exact forward carry (bf16 callers would otherwise replay rounded
     # snapshots); the primal path emits the caller's dtype directly
     hc_dtype = jnp.float32 if with_gates else x_tm.dtype
     out_specs = [
-        pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
-        pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0)),
+        pl.BlockSpec((1, bt, hidden), tm),
+        pl.BlockSpec((1, bt, hidden), tm),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((t, b, hidden), hc_dtype),
         jax.ShapeDtypeStruct((t, b, hidden), hc_dtype),
     ]
     if with_gates:
-        out_specs.append(pl.BlockSpec((1, b, four_h),
-                                      lambda i: (i, 0, 0)))
+        out_specs.append(pl.BlockSpec((1, bt, four_h), tm))
         out_shape.append(jax.ShapeDtypeStruct((t, b, four_h),
                                               jnp.float32))
     return pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, four_h), lambda i: (i, 0, 0)),
-            pl.BlockSpec((hidden, four_h), lambda i: (0, 0)),
-            pl.BlockSpec((3, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, bt, four_h), tm),
+            pl.BlockSpec((hidden, four_h), lambda j, i: (0, 0)),
+            pl.BlockSpec((3, hidden), lambda j, i: (0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((b, hidden), jnp.float32),
-            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((bt, hidden), jnp.float32),
+            pltpu.VMEM((bt, hidden), jnp.float32),
         ],
         interpret=interpret,
     )(x_tm, w, pw)
@@ -204,28 +243,32 @@ def _lstm_forward(x_tm, w, pw, with_gates, interpret):
 def _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c, interpret):
     t, b, four_h = gates.shape
     hidden = four_h // 4
+    bt = _batch_tile(b, hidden, four_h)
+    nb = b // bt
     zrow = jnp.zeros((1, b, hidden), hs.dtype)
     h_prev = jnp.concatenate([zrow, hs[:-1]], axis=0)
     c_prev = jnp.concatenate([zrow, cs[:-1]], axis=0)
-    rev = lambda i: (t - 1 - i, 0, 0)
-    kernel = functools.partial(_lstm_bwd_kernel, hidden=hidden, nt=t)
+    rev = lambda j, i: (t - 1 - i, j, 0)
+    const = lambda j, i: (0, 0)
+    kernel = functools.partial(_lstm_bwd_kernel, hidden=hidden, nt=t,
+                               nb=nb)
     dx, dw, dpw = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, four_h), rev),    # gates
-            pl.BlockSpec((1, b, hidden), rev),    # c_t
-            pl.BlockSpec((1, b, hidden), rev),    # c_{t-1}
-            pl.BlockSpec((1, b, hidden), rev),    # h_{t-1}
-            pl.BlockSpec((1, b, hidden), rev),    # ct_h
-            pl.BlockSpec((1, b, hidden), rev),    # ct_c
-            pl.BlockSpec((hidden, four_h), lambda i: (0, 0)),
-            pl.BlockSpec((3, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, bt, four_h), rev),    # gates
+            pl.BlockSpec((1, bt, hidden), rev),    # c_t
+            pl.BlockSpec((1, bt, hidden), rev),    # c_{t-1}
+            pl.BlockSpec((1, bt, hidden), rev),    # h_{t-1}
+            pl.BlockSpec((1, bt, hidden), rev),    # ct_h
+            pl.BlockSpec((1, bt, hidden), rev),    # ct_c
+            pl.BlockSpec((hidden, four_h), const),
+            pl.BlockSpec((3, hidden), const),
         ],
         out_specs=[
-            pl.BlockSpec((1, b, four_h), rev),
-            pl.BlockSpec((hidden, four_h), lambda i: (0, 0)),
-            pl.BlockSpec((3, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, bt, four_h), rev),
+            pl.BlockSpec((hidden, four_h), const),
+            pl.BlockSpec((3, hidden), const),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, b, four_h), jnp.float32),
@@ -233,8 +276,8 @@ def _lstm_backward(w, pw, hs, cs, gates, ct_h, ct_c, interpret):
             jax.ShapeDtypeStruct((3, hidden), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((b, hidden), jnp.float32),
-            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((bt, hidden), jnp.float32),
+            pltpu.VMEM((bt, hidden), jnp.float32),
             pltpu.VMEM((hidden, four_h), jnp.float32),
             pltpu.VMEM((3, hidden), jnp.float32),
         ],
@@ -290,7 +333,7 @@ def _gru_kernel(x_ref, w_ref, h0_ref, o_ref, *o_g_and_scr, hidden,
                 with_gates):
     o_g_ref = o_g_and_scr[0] if with_gates else None
     h_scr = o_g_and_scr[-1]
-    t = pl.program_id(0)
+    t = pl.program_id(1)  # grid = (batch_tiles, time); time innermost
 
     @pl.when(t == 0)
     def _init():
@@ -316,15 +359,21 @@ def _gru_kernel(x_ref, w_ref, h0_ref, o_ref, *o_g_and_scr, hidden,
 
 
 def _gru_bwd_kernel(gates_ref, hprev_ref, cth_ref, w_ref, dx_ref, dw_ref,
-                    dh0_ref, dh_scr, dw_scr, *, hidden, nt):
-    """Reverse-time GRU BPTT: grid step idx processes t = nt-1-idx; the
-    dh chain and dW accumulator live in VMEM (no forward recompute)."""
-    idx = pl.program_id(0)
+                    dh0_ref, dh_scr, dw_scr, *, hidden, nt, nb):
+    """Reverse-time GRU BPTT over grid (batch_tiles, time): time step
+    idx processes t = nt-1-idx; the dh chain and dW accumulator live in
+    VMEM (no forward recompute).  dh resets per batch tile; dW
+    accumulates across all tiles."""
+    bi = pl.program_id(0)
+    idx = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(bi == 0, idx == 0))
+    def _init_acc():
+        dw_scr[...] = jnp.zeros_like(dw_scr[...])
 
     @pl.when(idx == 0)
     def _init():
         dh_scr[...] = jnp.zeros_like(dh_scr[...])
-        dw_scr[...] = jnp.zeros_like(dw_scr[...])
 
     g = gates_ref[0]                       # [B, 3H] f32 (u, r, c)
     u = g[:, :hidden]
@@ -359,10 +408,13 @@ def _gru_bwd_kernel(gates_ref, hprev_ref, cth_ref, w_ref, dx_ref, dw_ref,
         preferred_element_type=jnp.float32)
 
     @pl.when(idx == nt - 1)
+    def _finish_tile():
+        # the final dh chain value IS this tile's d h0
+        dh0_ref[...] = dh_scr[...].astype(dh0_ref.dtype)
+
+    @pl.when(jnp.logical_and(bi == nb - 1, idx == nt - 1))
     def _finish():
         dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
-        # the final dh chain value IS d h0
-        dh0_ref[...] = dh_scr[...].astype(dh0_ref.dtype)
 
 
 def _gru_scan_reference(x_tm, w):
@@ -390,27 +442,29 @@ def _gru_scan_reference(x_tm, w):
 def _gru_forward(x_tm, w, h0, with_gates, interpret):
     t, b, three_h = x_tm.shape
     hidden = three_h // 3
+    bt = _batch_tile(b, hidden, three_h)
+    nb = b // bt
     kernel = functools.partial(_gru_kernel, hidden=hidden,
                                with_gates=with_gates)
+    tm = lambda j, i: (i, j, 0)
     h_dtype = jnp.float32 if with_gates else x_tm.dtype  # see LSTM note
-    out_specs = [pl.BlockSpec((1, b, hidden), lambda i: (i, 0, 0))]
+    out_specs = [pl.BlockSpec((1, bt, hidden), tm)]
     out_shape = [jax.ShapeDtypeStruct((t, b, hidden), h_dtype)]
     if with_gates:
-        out_specs.append(pl.BlockSpec((1, b, three_h),
-                                      lambda i: (i, 0, 0)))
+        out_specs.append(pl.BlockSpec((1, bt, three_h), tm))
         out_shape.append(jax.ShapeDtypeStruct((t, b, three_h),
                                               jnp.float32))
     out = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, three_h), lambda i: (i, 0, 0)),
-            pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
-            pl.BlockSpec((b, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, bt, three_h), tm),
+            pl.BlockSpec((hidden, three_h), lambda j, i: (0, 0)),
+            pl.BlockSpec((bt, hidden), lambda j, i: (j, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((b, hidden), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bt, hidden), jnp.float32)],
         interpret=interpret,
     )(x_tm, w, h0)
     return out if with_gates else (out[0], None)
@@ -419,23 +473,27 @@ def _gru_forward(x_tm, w, h0, with_gates, interpret):
 def _gru_backward(w, h0, hs, gates, ct_h, interpret):
     t, b, three_h = gates.shape
     hidden = three_h // 3
+    bt = _batch_tile(b, hidden, three_h)
+    nb = b // bt
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]],
                              axis=0)
-    rev = lambda i: (t - 1 - i, 0, 0)
-    kernel = functools.partial(_gru_bwd_kernel, hidden=hidden, nt=t)
+    rev = lambda j, i: (t - 1 - i, j, 0)
+    const = lambda j, i: (0, 0)
+    kernel = functools.partial(_gru_bwd_kernel, hidden=hidden, nt=t,
+                               nb=nb)
     dx, dw, dh0 = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, three_h), rev),   # gates (u, r, c)
-            pl.BlockSpec((1, b, hidden), rev),    # h_{t-1}
-            pl.BlockSpec((1, b, hidden), rev),    # ct_h
-            pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
+            pl.BlockSpec((1, bt, three_h), rev),   # gates (u, r, c)
+            pl.BlockSpec((1, bt, hidden), rev),    # h_{t-1}
+            pl.BlockSpec((1, bt, hidden), rev),    # ct_h
+            pl.BlockSpec((hidden, three_h), const),
         ],
         out_specs=[
-            pl.BlockSpec((1, b, three_h), rev),
-            pl.BlockSpec((hidden, three_h), lambda i: (0, 0)),
-            pl.BlockSpec((b, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, bt, three_h), rev),
+            pl.BlockSpec((hidden, three_h), const),
+            pl.BlockSpec((bt, hidden), lambda j, i: (j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((t, b, three_h), jnp.float32),
@@ -443,7 +501,7 @@ def _gru_backward(w, h0, hs, gates, ct_h, interpret):
             jax.ShapeDtypeStruct((b, hidden), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((b, hidden), jnp.float32),
+            pltpu.VMEM((bt, hidden), jnp.float32),
             pltpu.VMEM((hidden, three_h), jnp.float32),
         ],
         interpret=interpret,

@@ -73,12 +73,15 @@ def _rnn_vmem_budget():
 
 def _pallas_rnn_fits_vmem(batch, hidden, gate_width):
     """The BPTT kernel keeps the weight block AND an equally-sized f32
-    dW accumulator resident in VMEM for the whole grid, plus a few
-    [B, gate_width] tiles; past the budget Mosaic's scratch allocation
-    fails, so larger configs fall back to the lax.scan path."""
-    resident = 2 * hidden * gate_width * 4
-    tiles = 8 * batch * gate_width * 4
-    return resident + tiles <= _rnn_vmem_budget()
+    dW accumulator resident in VMEM for the whole grid, plus per-step
+    [bt, gate_width] tiles.  The batch dimension TILES (grid =
+    (batch_tiles, time)), so a config fits whenever ANY divisor of the
+    batch keeps the working set under budget — only a hidden size whose
+    resident weight+accumulator alone exceed VMEM falls back to the
+    lax.scan path."""
+    from .pallas.lstm_cell import pick_batch_tile
+    return pick_batch_tile(batch, hidden, gate_width,
+                           _rnn_vmem_budget()) is not None
 
 
 @register_op('lstm')

@@ -15,9 +15,13 @@ Levels:
             (dots_saveable): the usual sweet spot on MXU-heavy models.
   None    — turn remat back off.
 """
+import logging
+
 import jax
 
 __all__ = ['memory_optimize', 'release_memory', 'get_remat_policy']
+
+_log = logging.getLogger(__name__)
 
 _POLICIES = {
     'full': None,  # nothing saveable -> plain jax.checkpoint
@@ -42,7 +46,12 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False,
 
 def release_memory(input_program, skip_opt_set=None):
     """Reference release_memory parity: buffer release is XLA's job (donated
-    inputs + liveness); nothing to rewrite — kept for API compatibility."""
+    inputs + liveness); nothing to rewrite — kept for API compatibility.
+    Logs that it intentionally did nothing so users don't mistake the
+    no-op for a memory optimization."""
+    _log.info("release_memory: no-op on TPU — XLA owns buffer lifetimes "
+              "(donated inputs + liveness analysis); use memory_optimize() "
+              "for rematerialization")
     return input_program
 
 

@@ -288,3 +288,52 @@ def test_gru_op_pallas_h0_grads_match_scan():
     for a, b_, name in zip(g_scan, g_pal, ('dx', 'dw', 'dh0')):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_batch_tiled_kernels_match_untiled(monkeypatch):
+    """Large batches TILE the grid (grid=(batch_tiles, time)) instead of
+    falling back to lax.scan.  Force tiny tiles via the VMEM budget env
+    and check fwd+grad parity with the untiled kernel for LSTM and GRU
+    (incl. GRU's per-tile dh0 and the cross-tile dW accumulation)."""
+    from paddle_tpu.ops.pallas import gru_scan
+    from paddle_tpu.ops.pallas.lstm_cell import pick_batch_tile
+
+    B, T, H = 16, 5, 8
+    x4 = jnp.asarray(rng.randn(T, B, 4 * H), jnp.float32)
+    w4 = jnp.asarray(rng.randn(H, 4 * H) * 0.5, jnp.float32)
+    x3 = jnp.asarray(rng.randn(T, B, 3 * H), jnp.float32)
+    w3 = jnp.asarray(rng.randn(H, 3 * H) * 0.5, jnp.float32)
+    h0 = jnp.asarray(rng.randn(B, H), jnp.float32)
+
+    def lstm_loss(x, w):
+        hs, cs = lstm_scan(x, w)
+        return jnp.sum(jnp.sin(hs)) + jnp.sum(cs ** 2)
+
+    def gru_loss(x, w, h0):
+        return jnp.sum(jnp.sin(gru_scan(x, w, h0)))
+
+    want_l = lstm_loss(x4, w4)
+    want_gl = jax.grad(lstm_loss, argnums=(0, 1))(x4, w4)
+    want_g = gru_loss(x3, w3, h0)
+    want_gg = jax.grad(gru_loss, argnums=(0, 1, 2))(x3, w3, h0)
+
+    # budget so small the batch must split into multiple tiles
+    monkeypatch.setenv('PADDLE_TPU_RNN_VMEM_BUDGET_MB', '0.006')
+    bt = pick_batch_tile(B, H, 4 * H, int(0.006 * 1024 * 1024))
+    assert bt is not None and bt < B, bt
+    jax.clear_caches()
+    try:
+        np.testing.assert_allclose(np.asarray(lstm_loss(x4, w4)),
+                                   np.asarray(want_l), rtol=1e-5)
+        got_gl = jax.grad(lstm_loss, argnums=(0, 1))(x4, w4)
+        for a, b in zip(got_gl, want_gl):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gru_loss(x3, w3, h0)),
+                                   np.asarray(want_g), rtol=1e-5)
+        got_gg = jax.grad(gru_loss, argnums=(0, 1, 2))(x3, w3, h0)
+        for a, b in zip(got_gg, want_gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        jax.clear_caches()  # drop kernels traced under the tiny budget
