@@ -54,7 +54,10 @@ def test_v2_trainer_event_loop_and_infer():
         for batch in reader():
             yield batch
 
-    trainer.train(batched, num_passes=2, event_handler=handler)
+    # explicit column pairing (the reference v2 feeding= map); also keeps
+    # the declaration-order fallback warning out of multi-input training
+    trainer.train(batched, num_passes=2, event_handler=handler,
+                  feeding={'img': 0, 'label': 1})
 
     assert events['begin_pass'] == 2 and events['end_pass'] == 2
     assert events['iters'] == 24
@@ -62,7 +65,7 @@ def test_v2_trainer_event_loop_and_infer():
     assert np.mean(costs[-4:]) < np.mean(costs[:4])
 
     # test(): for_test program, average metrics
-    result = trainer.test(batched)
+    result = trainer.test(batched, feeding={'img': 0, 'label': 1})
     assert isinstance(result, highlevel.event.TestResult)
     assert np.isfinite(result.cost)
     assert result.metrics['acc'] > 0.5  # separable clusters are learnable

@@ -148,3 +148,50 @@ def test_run_steps_matches_run_loop():
                             repeat=4)[0]
     np.testing.assert_allclose(np.ravel(got_rep), want_rep, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_run_steps_stacked_ragged_feeds_match_run_loop():
+    """Stacked-feeds run_steps with (array, lengths) ragged feeds: the
+    @LEN companions stack and scan along with the data, matching K
+    run() calls exactly (ragged mean masks padded positions)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 23
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[1], dtype='int64',
+                                      lod_level=1)
+                emb = fluid.layers.embedding(input=x, size=[30, 6])
+                pooled = fluid.layers.sequence_pool(input=emb,
+                                                    pool_type='sum')
+                pred = fluid.layers.fc(input=pooled, size=1)
+                loss = fluid.layers.mean(x=fluid.layers.square(x=pred))
+                fluid.optimizer.SGDOptimizer(
+                    learning_rate=0.01).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(11)
+    batches = []
+    for _ in range(3):
+        ids = rng.randint(0, 30, (4, 7, 1)).astype('int64')
+        ln = rng.randint(1, 8, (4,)).astype('int32')
+        batches.append({'x': (ids, ln)})
+
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want = [float(np.ravel(exe.run(main, feed=f,
+                                   fetch_list=[loss])[0])[0])
+            for f in batches]
+
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run_steps(main, feed=batches, fetch_list=[loss])[0]
+    np.testing.assert_allclose(np.ravel(got), want, rtol=1e-5,
+                               atol=1e-6)
