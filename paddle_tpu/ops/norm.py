@@ -22,22 +22,36 @@ from ..core.registry import register_op
 from .common import first
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _bn_train(x, scale, bias, axes, eps):
-    y, m, v, _inv = _bn_train_fwd_impl(x, scale, bias, axes, eps)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _bn_train(x, scale, bias, shift, axes, eps, use_shift):
+    y, m, v, _inv = _bn_train_fwd_impl(x, scale, bias, shift, axes, eps,
+                                       use_shift)
     return y, m, v
 
 
-def _bn_train_fwd_impl(x, scale, bias, axes, eps):
-    # two-pass stats (mean, then E[(x-m)^2]): E[x^2]-m^2 would cancel
-    # catastrophically for large-mean activations.  Converts fuse INTO
-    # the reductions (bf16 reads, f32 accumulate) — no materialized f32
-    # copy of x
-    m = jnp.mean(x, axis=axes, dtype=jnp.float32)
-    mb = m.reshape(_bcast_shape(x, axes))
-    v = jnp.mean(jnp.square(x.astype(jnp.float32) - mb), axis=axes)
-    inv = jax.lax.rsqrt(v + eps)
+def _bn_train_fwd_impl(x, scale, bias, shift, axes, eps, use_shift):
+    """use_shift=False: two-pass stats (mean, then E[(x-m)^2]) — exact,
+    used off-TPU.  use_shift=True: SINGLE-pass stats shifted by the
+    running mean, var = E[(x-s)^2] - (m-s)^2, both reductions reading x
+    once (multi-output fusion, bf16 reads, f32 accumulate).  Plain
+    E[x^2]-m^2 cancels catastrophically for large-mean activations; the
+    running-mean shift keeps |m-s| ~ 0 (it tracks the batch mean), so
+    the subtraction is well-conditioned wherever the running stats have
+    warmed up, and at init (s=0) it degrades to the centered case that
+    fresh nets with near-zero-mean activations occupy anyway."""
     bshape = _bcast_shape(x, axes)
+    if not use_shift:
+        m = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        v = jnp.mean(jnp.square(x.astype(jnp.float32)
+                                - m.reshape(bshape)), axis=axes)
+    else:
+        s = shift.astype(jnp.float32)
+        xs = x.astype(jnp.float32) - s.reshape(bshape)
+        m_s = jnp.mean(xs, axis=axes)
+        msq_s = jnp.mean(jnp.square(xs), axis=axes)
+        m = m_s + s
+        v = jnp.maximum(msq_s - m_s * m_s, 0.0)
+    inv = jax.lax.rsqrt(v + eps)
     y = ((x.astype(jnp.float32) - m.reshape(bshape)) * inv.reshape(bshape)
          * scale.reshape(bshape) + bias.reshape(bshape))
     return y.astype(x.dtype), m, v, inv
@@ -47,12 +61,13 @@ def _bcast_shape(x, axes):
     return tuple(1 if i in axes else x.shape[i] for i in range(x.ndim))
 
 
-def _bn_fwd(x, scale, bias, axes, eps):
-    y, m, v, inv = _bn_train_fwd_impl(x, scale, bias, axes, eps)
+def _bn_fwd(x, scale, bias, shift, axes, eps, use_shift):
+    y, m, v, inv = _bn_train_fwd_impl(x, scale, bias, shift, axes, eps,
+                                      use_shift)
     return (y, m, v), (x, scale, m, inv)
 
 
-def _bn_bwd(axes, eps, res, cts):
+def _bn_bwd(axes, eps, use_shift, res, cts):
     x, scale, m, inv = res
     dy, dm_ct, dv_ct = cts
     n = 1
@@ -75,7 +90,8 @@ def _bn_bwd(axes, eps, res, cts):
     # XLA's algebraic simplifier erases; kept for exactness elsewhere
     dx = dx + (dm_ct / n).reshape(bshape)
     dx = dx + (dv_ct * 2.0 / n).reshape(bshape) * (xf - mb)
-    return dx.astype(x.dtype), s2, s1
+    # the shift is running state, not a differentiated input
+    return dx.astype(x.dtype), s2, s1, jnp.zeros_like(m)
 
 
 _bn_train.defvjp(_bn_fwd, _bn_bwd)
@@ -110,7 +126,11 @@ def _batch_norm(ctx, ins, attrs):
             'SavedMean': [mean],
             'SavedVariance': [var],
         }
-    y, use_mean, use_var = _bn_train(x, scale, bias, axes, float(eps))
+    # single-pass shifted stats on TPU (one read of x); exact two-pass
+    # elsewhere (CPU runs double as the numerics oracle)
+    use_shift = getattr(ctx, 'backend', None) == 'tpu'
+    y, use_mean, use_var = _bn_train(x, scale, bias, mean, axes,
+                                     float(eps), use_shift)
     mean_out = momentum * mean + (1 - momentum) * use_mean
     var_out = momentum * var + (1 - momentum) * use_var
     return {

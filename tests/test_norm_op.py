@@ -111,3 +111,43 @@ def test_squared_l2_distance():
     want = ((x - y) ** 2).sum(axis=1, keepdims=True)
     np.testing.assert_allclose(np.asarray(outs['Out'][0]), want,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_bn_shifted_single_pass_stats_match_two_pass():
+    """The TPU single-pass shifted stats (var = E[(x-s)^2]-(m-s)^2 with
+    s = running mean) match the exact two-pass form, including for
+    large-mean activations where the UNSHIFTED E[x^2]-m^2 form loses
+    all precision to cancellation."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.norm import _bn_train_fwd_impl
+
+    rng = np.random.RandomState(33)
+    axes = (0, 1, 2)
+    scale = jnp.ones((8,), jnp.float32)
+    bias = jnp.zeros((8,), jnp.float32)
+
+    # pathological: mean ~1e4, std ~1 — m^2 has f32 ulp ~0.01*sigma^2
+    x = (1e4 + rng.randn(4, 6, 6, 8)).astype('float32')
+    true_var = np.var(np.float64(x), axis=axes)
+    shift = jnp.asarray(x.mean(axis=axes) + 0.3 * rng.randn(8),
+                        jnp.float32)  # warmed-up running mean
+    _, m1, v1, _ = _bn_train_fwd_impl(jnp.asarray(x), scale, bias,
+                                      None, axes, 1e-5, False)
+    _, m2, v2, _ = _bn_train_fwd_impl(jnp.asarray(x), scale, bias,
+                                      shift, axes, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v2), true_var, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(v1), true_var, rtol=1e-3)
+
+    # ordinary activations with a cold (zero) running mean
+    x = rng.randn(4, 6, 6, 8).astype('float32')
+    _, m1, v1, _ = _bn_train_fwd_impl(jnp.asarray(x), scale, bias,
+                                      None, axes, 1e-5, False)
+    _, m2, v2, _ = _bn_train_fwd_impl(jnp.asarray(x), scale, bias,
+                                      jnp.zeros((8,), jnp.float32),
+                                      axes, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(v1),
+                               rtol=1e-5, atol=1e-6)
