@@ -316,6 +316,33 @@ def _np_to_device_dtype(arr, var):
     return arr
 
 
+def make_multi_step_fn(raw_fn, stacked, k):
+    """The K-step lax.scan over a traced step function — the single home
+    of the multi-step semantics shared by Executor.run_steps and
+    parallel.api.run_steps_sharded: persistable state is the carry, the
+    per-step PRNG folds (key0, global_step) exactly like K single runs,
+    fetches stack along a leading K axis, and out-only state (written,
+    not carried) surfaces as its last-step value."""
+    def multi_fn(feed_one, xs_feeds, state_rw, state_ro, key0, t0):
+        def body(carry, xs_t):
+            rw, t = carry
+            f_t = xs_t if stacked else feed_one
+            key = jax.random.fold_in(key0, t)
+            fetches, new_state = raw_fn(f_t, rw, state_ro, key)
+            new_rw = {n: new_state[n] for n in rw if n in new_state}
+            extra = {n: v for n, v in new_state.items()
+                     if n not in new_rw}
+            return (new_rw, t + 1), (tuple(fetches), extra)
+
+        (rw_f, _), (ys, extras) = jax.lax.scan(
+            body, (state_rw, t0), xs_feeds,
+            length=None if stacked else k)
+        last_extra = jax.tree_util.tree_map(lambda a: a[-1], extras)
+        return ys, rw_f, last_extra
+
+    return multi_fn
+
+
 class Executor(object):
     def __init__(self, place=None):
         if isinstance(place, (list, tuple)):
@@ -415,11 +442,13 @@ class Executor(object):
             return None
         return mesh
 
-    def _rng_key(self, program):
+    def _base_seed(self, program):
         seed = program.random_seed
-        if seed == 0:
-            seed = id(self) % (2**31)
-        return jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        return seed if seed else id(self) % (2**31)
+
+    def _rng_key(self, program):
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self._base_seed(program)), self._step)
 
     def _analyze_state(self, program, scope, feed_names):
         """Classify persistable vars: `rw` (existing value, written → passed
@@ -562,27 +591,8 @@ class Executor(object):
                 rw_names, ro_names)
         multi = self._cache.get(mkey)
         if multi is None:
-            def multi_fn(feed_one, xs_feeds, state_rw, state_ro, key0,
-                         t0):
-                def body(carry, xs_t):
-                    rw, t = carry
-                    f_t = xs_t if stacked else feed_one
-                    key = jax.random.fold_in(key0, t)
-                    fetches, new_state = raw_fn(f_t, rw, state_ro, key)
-                    new_rw = {n: new_state[n] for n in rw_names
-                              if n in new_state}
-                    extra = {n: v for n, v in new_state.items()
-                             if n not in new_rw}
-                    return (new_rw, t + 1), (tuple(fetches), extra)
-
-                (rw_f, _), (ys, extras) = jax.lax.scan(
-                    body, (state_rw, t0), xs_feeds,
-                    length=None if stacked else k)
-                last_extra = jax.tree_util.tree_map(lambda a: a[-1],
-                                                    extras)
-                return ys, rw_f, last_extra
-
-            multi = jax.jit(multi_fn, donate_argnums=(2,))
+            multi = jax.jit(make_multi_step_fn(raw_fn, stacked, k),
+                            donate_argnums=(2,))
             self._cache[mkey] = multi
 
         xs = None
@@ -600,10 +610,8 @@ class Executor(object):
 
         state_rw = {n: scope.get(n) for n in rw_names}
         state_ro = {n: scope.get(n) for n in ro_names}
-        seed = program.random_seed
-        if seed == 0:
-            seed = id(self) % (2**31)
-        key0 = jax.device_put(jax.random.PRNGKey(seed), dev)
+        key0 = jax.device_put(
+            jax.random.PRNGKey(self._base_seed(program)), dev)
         t0 = jnp.asarray(self._step, jnp.int32)
 
         ys, rw_f, last_extra = multi(feed0, xs, state_rw, state_ro,

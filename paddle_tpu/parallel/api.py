@@ -11,12 +11,13 @@ import contextlib
 import threading
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ['make_mesh', 'mesh_guard', 'current_mesh', 'shard_tensor',
            'replicate', 'batch_sharding', 'param_sharding', 'run_sharded',
-           'P']
+           'run_steps_sharded', 'P']
 
 _state = threading.local()
 
@@ -135,6 +136,9 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
     mesh = current_mesh()
     if mesh is None:
         raise RuntimeError("run_sharded requires a mesh_guard")
+    if program is None:
+        from ..core.program import default_main_program
+        program = default_main_program()
     raw_fn, args = exe.compile_raw(program, feed=feed,
                                    fetch_list=fetch_list, scope=scope)
     feed_arrays, state_rw, state_ro, rng_key = args
@@ -187,3 +191,95 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
     for n, v in new_state.items():
         scope.set(n, v)
     return [_fetch_np(v) for v in fetches]
+
+
+def run_steps_sharded(exe, program, feed, fetch_list, scope,
+                      batch_axis='dp', param_axis=None, repeat=None):
+    """K SPMD train steps as ONE sharded lax.scan over the mesh — the
+    run_sharded counterpart of Executor.run_steps: persistable state is
+    the donated carry (it never leaves the mesh between steps) and the
+    per-step PRNG folds (seed, global_step) exactly like K run_sharded
+    calls.  `feed` is a list of K feed dicts (stacked host-side, batch
+    dim sharded over `batch_axis`) or one dict with repeat=K.  Fetches
+    return [K, ...]-stacked numpy."""
+    mesh = current_mesh()
+    if mesh is None:
+        raise RuntimeError("run_steps_sharded requires a mesh_guard")
+    if program is None:
+        from ..core.program import default_main_program
+        program = default_main_program()
+    if isinstance(feed, dict):
+        if not repeat:
+            raise ValueError("single feed dict needs repeat=K")
+        feeds, k = [feed], int(repeat)
+    else:
+        feeds, k = list(feed), len(feed)
+        if repeat:
+            raise ValueError("repeat= only combines with a single dict")
+        if k == 0:
+            return []
+    stacked = len(feeds) > 1
+
+    raw_fn, args = exe.compile_raw(program, feed=feeds[0],
+                                   fetch_list=fetch_list, scope=scope)
+    feed_arrays, state_rw, state_ro, _rng_key = args
+
+    feed_sh = {n: batch_sharding(mesh, batch_axis, np.ndim(v))
+               for n, v in feed_arrays.items()}
+    xs_sh = {n: NamedSharding(mesh, P(None, *s.spec))
+             for n, s in feed_sh.items()}
+    rw_sh = {n: param_sharding(mesh, param_axis, np.shape(v))
+             for n, v in state_rw.items()}
+    ro_sh = {n: param_sharding(mesh, param_axis, np.shape(v))
+             for n, v in state_ro.items()}
+    key_sh = replicate(mesh)
+
+    cache = getattr(exe, '_sharded_cache', None)
+    if cache is None:
+        cache = exe._sharded_cache = {}
+    sig = tuple((n, np.shape(v), str(np.asarray(v).dtype) if not
+                 hasattr(v, 'dtype') else str(v.dtype))
+                for d in (feed_arrays, state_rw, state_ro)
+                for n, v in sorted(d.items()))
+    mkey = ('multi', program._uid, program.version, mesh, batch_axis,
+            param_axis, k, stacked,
+            tuple(getattr(f, 'name', str(f)) for f in fetch_list), sig)
+    fn = cache.get(mkey)
+    if fn is None:
+        from ..core.executor import make_multi_step_fn
+        fn = jax.jit(
+            make_multi_step_fn(raw_fn, stacked, k),
+            in_shardings=(feed_sh, xs_sh if stacked else None, rw_sh,
+                          ro_sh, key_sh, key_sh),
+            donate_argnums=(2,))
+        cache[mkey] = fn
+
+    feed0 = {n: _place(v, feed_sh[n]) for n, v in feed_arrays.items()}
+    xs = None
+    if stacked:
+        from ..core.executor import _to_feed_arrays
+        block = program.global_block()
+        cols = {}
+        for f in feeds:
+            fa = {}
+            for name, value in f.items():
+                fa.update(_to_feed_arrays(name, value,
+                                          block.vars.get(name)))
+            for n, v in fa.items():
+                cols.setdefault(n, []).append(np.asarray(v))
+        xs = {n: _place(np.stack(vs), xs_sh[n])
+              for n, vs in cols.items()}
+    state_rw = {n: _place(v, rw_sh[n]) for n, v in state_rw.items()}
+    state_ro = {n: _place(v, ro_sh[n]) for n, v in state_ro.items()}
+    for n, v in state_ro.items():
+        scope.set(n, v)
+    key0 = _place(jax.random.PRNGKey(exe._base_seed(program)), key_sh)
+    t0 = _place(jnp.asarray(exe._step, jnp.int32), key_sh)
+
+    ys, rw_f, last_extra = fn(feed0, xs, state_rw, state_ro, key0, t0)
+    exe._step += k
+    for n, v in rw_f.items():
+        scope.set(n, v)
+    for n, v in last_extra.items():
+        scope.set(n, v)
+    return [_fetch_np(y) for y in ys]

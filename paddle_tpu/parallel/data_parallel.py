@@ -39,6 +39,20 @@ class DataParallel(object):
                 scope=scope, batch_axis=self.axis,
                 param_axis=self.fsdp_axis)
 
+    def run_steps(self, program=None, feed=None, fetch_list=None,
+                  scope=None, repeat=None):
+        """K sharded steps as one lax.scan over the mesh (the SPMD
+        counterpart of Executor.run_steps): state stays sharded on the
+        mesh between steps — no per-step host dispatch — and numerics
+        match K run() calls exactly."""
+        from ..core.scope import global_scope
+        scope = scope or global_scope()
+        with api.mesh_guard(self.mesh):
+            return api.run_steps_sharded(
+                self.exe, program, feed=feed, fetch_list=fetch_list,
+                scope=scope, batch_axis=self.axis,
+                param_axis=self.fsdp_axis, repeat=repeat)
+
 
 def fsdp_shardings(mesh, state, axis='fsdp'):
     """ZeRO-3-style shardings for a {name: array} state dict: every tensor
