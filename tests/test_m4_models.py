@@ -21,6 +21,9 @@ def test_m4_model_trains(name):
     build, shape, classes = CONFIGS[name]
     main = fluid.Program()
     startup = fluid.Program()
+    # deterministic init: with seed 0 the executor seeds from id(self),
+    # so the one-step-decreases assertion would depend on luck of init
+    main.random_seed = startup.random_seed = 7
     with fluid.program_guard(main, startup):
         images = fluid.layers.data(name='pixel', shape=shape, dtype='float32')
         label = fluid.layers.data(name='label', shape=[1], dtype='int64')
