@@ -10,6 +10,9 @@ from paddle_tpu import datasets
 
 
 def test_mnist_if_else_trains():
+    # deterministic init (fresh default programs per test via conftest)
+    fluid.default_main_program().random_seed = 11
+    fluid.default_startup_program().random_seed = 11
     image = fluid.layers.data(name='x', shape=[784], dtype='float32')
     label = fluid.layers.data(name='y', shape=[1], dtype='int64')
     limit = fluid.layers.fill_constant_batch_size_like(
