@@ -205,6 +205,17 @@ def test_two_process_fsdp_train_step():
         print('RANK%s_LOSSES=%s' % (os.environ['PADDLE_TPU_PROC_ID'],
                                     ','.join('%.6f' % v for v in losses)),
               flush=True)
+        # same 3 steps as ONE sharded lax.scan across both processes
+        main, startup, loss = build_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        dp = DataParallel(exe, mesh, axis='fsdp', fsdp_axis='fsdp')
+        scan = dp.run_steps(main, feed=mlp_batches(3),
+                            fetch_list=[loss])[0]
+        print('RANK%s_SCAN=%s' % (os.environ['PADDLE_TPU_PROC_ID'],
+                                  ','.join('%.6f' % v for v in
+                                           np.ravel(scan))),
+              flush=True)
         launch.shutdown()
     ''')
 
@@ -230,9 +241,9 @@ def test_two_process_fsdp_train_step():
                 p.kill()
                 p.wait()
     for rank, out in enumerate(outs):
-        tag = 'RANK%d_LOSSES=' % rank
-        assert tag in out, (rank, out[-3000:])
-        got = [float(v) for v in
-               out.split(tag)[1].splitlines()[0].split(',')]
-        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
-                                   err_msg='rank %d' % rank)
+        for tag in ('RANK%d_LOSSES=' % rank, 'RANK%d_SCAN=' % rank):
+            assert tag in out, (rank, out[-3000:])
+            got = [float(v) for v in
+                   out.split(tag)[1].splitlines()[0].split(',')]
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg='rank %d %s' % (rank, tag))

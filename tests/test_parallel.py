@@ -507,6 +507,7 @@ def test_sharded_run_steps_matches_run_loop():
                 y = fluid.layers.data(name='y', shape=[1],
                                       dtype='float32')
                 h = fluid.layers.fc(input=x, size=32, act='relu')
+                h = fluid.layers.dropout(x=h, dropout_prob=0.2)
                 p = fluid.layers.fc(input=h, size=1)
                 loss = fluid.layers.mean(
                     x=fluid.layers.square_error_cost(input=p, label=y))
