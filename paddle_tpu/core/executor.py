@@ -316,6 +316,20 @@ def _np_to_device_dtype(arr, var):
     return arr
 
 
+def _stack_feed_col(name, vals):
+    """Stack one feed column across K steps; the scan needs identical
+    shapes per step (XLA static shapes), so say which feed broke the
+    contract instead of letting np.stack fail opaquely."""
+    shapes = {np.shape(v) for v in vals}
+    if len(shapes) > 1:
+        raise ValueError(
+            "run_steps feeds must agree in shape across steps (static "
+            "shapes — one compiled scan), but %r varies: %s.  Pad "
+            "batches to a common shape or fall back to per-step run()"
+            % (name, sorted(shapes)))
+    return np.stack(vals)
+
+
 def make_multi_step_fn(raw_fn, stacked, k):
     """The K-step lax.scan over a traced step function — the single home
     of the multi-step semantics shared by Executor.run_steps and
@@ -605,7 +619,7 @@ class Executor(object):
                     fa.update(_to_feed_arrays(name, value, var))
                 for n, v in fa.items():
                     cols.setdefault(n, []).append(np.asarray(v))
-            xs = {n: jax.device_put(np.stack(vs), dev)
+            xs = {n: jax.device_put(_stack_feed_col(n, vs), dev)
                   for n, vs in cols.items()}
 
         state_rw = {n: scope.get(n) for n in rw_names}

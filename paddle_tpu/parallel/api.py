@@ -267,7 +267,8 @@ def run_steps_sharded(exe, program, feed, fetch_list, scope,
                                           block.vars.get(name)))
             for n, v in fa.items():
                 cols.setdefault(n, []).append(np.asarray(v))
-        xs = {n: _place(np.stack(vs), xs_sh[n])
+        from ..core.executor import _stack_feed_col
+        xs = {n: _place(_stack_feed_col(n, vs), xs_sh[n])
               for n, vs in cols.items()}
     state_rw = {n: _place(v, rw_sh[n]) for n, v in state_rw.items()}
     state_ro = {n: _place(v, ro_sh[n]) for n, v in state_ro.items()}
