@@ -336,23 +336,32 @@ def make_multi_step_fn(raw_fn, stacked, k):
     parallel.api.run_steps_sharded: persistable state is the carry, the
     per-step PRNG folds (key0, global_step) exactly like K single runs,
     fetches stack along a leading K axis, and out-only state (written,
-    not carried) surfaces as its last-step value."""
+    not carried in) surfaces as its last-step value.  Out-only vars ride
+    the carry too — seeded from zeros placeholders discovered with
+    eval_shape at trace time — so each holds ONE buffer on device rather
+    than a [K, ...] stack that keeps K-1 dead copies live in HBM."""
     def multi_fn(feed_one, xs_feeds, state_rw, state_ro, key0, t0):
+        f0 = (jax.tree_util.tree_map(lambda a: a[0], xs_feeds)
+              if stacked else feed_one)
+        _, state_shape = jax.eval_shape(raw_fn, f0, state_rw, state_ro,
+                                        key0)
+        extra0 = {n: jnp.zeros(s.shape, s.dtype)
+                  for n, s in state_shape.items() if n not in state_rw}
+
         def body(carry, xs_t):
-            rw, t = carry
+            rw, extra, t = carry
             f_t = xs_t if stacked else feed_one
             key = jax.random.fold_in(key0, t)
             fetches, new_state = raw_fn(f_t, rw, state_ro, key)
             new_rw = {n: new_state[n] for n in rw if n in new_state}
-            extra = {n: v for n, v in new_state.items()
-                     if n not in new_rw}
-            return (new_rw, t + 1), (tuple(fetches), extra)
+            new_extra = {n: v for n, v in new_state.items()
+                         if n not in new_rw}
+            return (new_rw, new_extra, t + 1), tuple(fetches)
 
-        (rw_f, _), (ys, extras) = jax.lax.scan(
-            body, (state_rw, t0), xs_feeds,
+        (rw_f, extra_f, _), ys = jax.lax.scan(
+            body, (state_rw, extra0, t0), xs_feeds,
             length=None if stacked else k)
-        last_extra = jax.tree_util.tree_map(lambda a: a[-1], extras)
-        return ys, rw_f, last_extra
+        return ys, rw_f, extra_f
 
     return multi_fn
 
@@ -584,25 +593,44 @@ class Executor(object):
             if k == 0:
                 return []
         stacked = len(feeds) > 1
+        names0 = set(feeds[0])
+        for i, f in enumerate(feeds[1:], start=1):
+            if set(f) != names0:
+                missing = sorted(names0 - set(f))
+                extra = sorted(set(f) - names0)
+                raise ValueError(
+                    "run_steps feeds must use one key set across steps "
+                    "(one compiled scan); step %d %s" % (i, '; '.join(
+                        filter(None,
+                               ["is missing %s" % missing if missing
+                                else '',
+                                "adds %s" % extra if extra else '']))))
 
         dev = self.place.jax_device()
+        # Mirror run(): a parallel_do program traced under a mesh_guard
+        # spans the mesh's devices, so feeds/state/key stage replicated on
+        # the mesh and the mesh keys both plan caches.
+        mesh = self._active_mesh(program)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            dev = NamedSharding(mesh, PartitionSpec())
         feed0 = {}
         for name, value in feeds[0].items():
             var = block.vars.get(name)
             feed0.update(_to_feed_arrays(name, value, var))
-        feed0 = {n: (v if isinstance(v, jax.Array)
+        feed0 = {n: (v if isinstance(v, jax.Array) and mesh is None
                      else jax.device_put(v, dev))
                  for n, v in feed0.items()}
 
         fn_plan = self._get_plan(program, block, scope, feed0,
-                                 fetch_names, True)
+                                 fetch_names, True, mesh=mesh)
         _fn, raw_fn, rw_names, ro_names = fn_plan
 
         mkey = ('multi', program._uid, program.version, k, stacked,
                 fetch_names,
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
                       for n in sorted(feed0)), id(scope),
-                rw_names, ro_names)
+                rw_names, ro_names, mesh)
         multi = self._cache.get(mkey)
         if multi is None:
             multi = jax.jit(make_multi_step_fn(raw_fn, stacked, k),
@@ -624,6 +652,11 @@ class Executor(object):
 
         state_rw = {n: scope.get(n) for n in rw_names}
         state_ro = {n: scope.get(n) for n in ro_names}
+        if mesh is not None:
+            state_rw = {n: jax.device_put(v, dev)
+                        for n, v in state_rw.items()}
+            state_ro = {n: jax.device_put(v, dev)
+                        for n, v in state_ro.items()}
         key0 = jax.device_put(
             jax.random.PRNGKey(self._base_seed(program)), dev)
         t0 = jnp.asarray(self._step, jnp.int32)

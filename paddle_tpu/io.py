@@ -26,7 +26,9 @@ from .core.program import Parameter, Program, Variable, default_main_program
 from .core.scope import global_scope
 
 _MANIFEST = '__manifest__.json'
-_FORMAT_VERSION = 1
+# v2: shard records carry index-derived filenames (strings, not counters)
+# and multi-host saves write per-process __manifest__.p<K>.json files
+_FORMAT_VERSION = 2
 
 __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
@@ -59,25 +61,102 @@ def _sharding_of(value):
     return spec, sh.mesh
 
 
+def _shard_filename(name, idx):
+    """Deterministic shard filename derived from the global index bounds
+    (``v.shard.0_4x8_16.npy`` = rows [0,4) × cols [8,16)), so concurrent
+    hosts writing their own shards of the same var never collide and a
+    re-save of the same block overwrites in place."""
+    span = 'x'.join('%d_%d' % (a, b) for a, b in idx)
+    return '%s.shard.%s.npy' % (_safe(name), span or 'scalar')
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _atomic_save(path, arr):
+    """np.save via tmp+rename so a concurrent reader — or a replica of
+    the same block written by another host at the same moment — never
+    sees a torn .npy.  The tmp name carries (process_index, pid): pid
+    alone is not unique across hosts on a shared filesystem."""
+    tmp = '%s.tmp.p%d.%d' % (path, _process_index(), os.getpid())
+    with open(tmp, 'wb') as f:
+        np.save(f, np.asarray(arr))
+    os.replace(tmp, path)
+
+
+def _blocks_overlap(idx, jdx):
+    """True when two (start, stop)-bound blocks intersect in every dim —
+    the single overlap predicate shared by the manifest merge and the
+    _assemble disjointness check (they must agree: a block the merge
+    keeps as non-superseded must not collide in _assemble)."""
+    return all(a < d and c < b for (a, b), (c, d) in zip(idx, jdx))
+
+
 def _save_sharded(dirname, name, value):
-    """One .npy per unique shard (dedup replicated copies by index);
-    returns the manifest shard records.  Indices are normalized to
-    concrete (start, stop) bounds — jax yields slice(None) for unsharded
-    dims — so the load-time lookup matches exactly."""
-    seen = {}
+    """One .npy per unique addressable shard (dedup replicated copies by
+    index); returns the manifest shard records.  Indices are normalized
+    to concrete (start, stop) bounds — jax yields slice(None) for
+    unsharded dims — so the load-time lookup matches exactly.  Only
+    addressable shards are written: on multi-host each host contributes
+    its own blocks and its own manifest (see _write_manifest)."""
+    seen = set()
     shape = value.shape
+    records = []
     for shard in value.addressable_shards:
         idx = tuple((sl.start if sl.start is not None else 0,
                      sl.stop if sl.stop is not None else shape[d])
                     for d, sl in enumerate(shard.index))
         if idx in seen:
             continue
-        k = len(seen)
-        np.save(os.path.join(dirname, '%s.shard%d.npy' % (_safe(name), k)),
-                np.asarray(shard.data))
-        seen[idx] = k
-    return [{'index': [list(p) for p in idx], 'file': k}
-            for idx, k in seen.items()]
+        seen.add(idx)
+        fname = _shard_filename(name, idx)
+        _atomic_save(os.path.join(dirname, fname), shard.data)
+        records.append({'index': [list(p) for p in idx], 'file': fname})
+    return records
+
+
+def _merge_var_record(old, new, name):
+    """Merge two manifest records for the same var.
+
+    Records carry a save-generation counter (``gen``): differing gens
+    resolve wholesale to the higher one, so a torn re-save — host 0
+    wrote generation N, host 1 crashed still holding generation N-1
+    blocks under the SAME filenames/tiling — drops the stale record and
+    fails loudly in _assemble's coverage check rather than silently
+    stitching two generations.  Equal gens (hosts of one save, or
+    records predating the counter) union shard lists when
+    shape/dtype/spec agree — old blocks overlapping any new block are
+    superseded (a re-tiling) — and resolve to ``new`` wholesale when the
+    metadata differs."""
+    if old is None or 'shards' not in old or 'shards' not in new:
+        return new
+    og, ng = old.get('gen'), new.get('gen')
+    if og is not None and ng is not None and og != ng:
+        return new if ng > og else old
+    if any(old.get(k) != new.get(k) for k in ('shape', 'dtype', 'spec')):
+        return new
+    new_indices = [tuple(tuple(p) for p in s['index'])
+                   for s in new['shards']]
+
+    def superseded(jdx):
+        return any(jdx != idx and _blocks_overlap(idx, jdx)
+                   for idx in new_indices)
+
+    by_index = {}
+    for s in old['shards']:
+        jdx = tuple(tuple(p) for p in s['index'])
+        if not superseded(jdx):
+            by_index[jdx] = s
+    for s, idx in zip(new['shards'], new_indices):
+        by_index[idx] = s
+    merged = dict(new)
+    merged['shards'] = list(by_index.values())
+    return merged
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
@@ -88,8 +167,21 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         vars = list(filter(predicate, main_program.list_vars()))
     os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
-    manifest = _read_manifest(dirname) or {
+    # Seed from THIS process's previous manifest only — merging siblings
+    # here would copy other hosts' shard records into our manifest, and a
+    # torn later checkpoint (another host crashing mid-save) would then
+    # pass the load-time completeness check on our stale copy of its
+    # records.
+    manifest = _read_manifest(dirname, own_only=True) or {
         'format_version': _FORMAT_VERSION, 'vars': {}}
+    # Save generation: one past the newest this process has written into
+    # this directory.  Hosts of one multi-host save share checkpoint
+    # history, so they compute the SAME value independently — the merge
+    # key that lets _read_manifest tell sibling writers (equal gen, union
+    # shards) from a stale generation (lower gen, dropped) without
+    # trusting filesystem mtimes.
+    gen = 1 + max([r.get('gen', 0) for r in manifest['vars'].values()]
+                  + [0])
     for var in vars:
         name = var.name if isinstance(var, Variable) else var
         value = scope.find_var(name)
@@ -98,30 +190,94 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         rec = {'shape': [int(d) for d in np.shape(value)],
                'dtype': str(np.asarray(value).dtype
                             if not hasattr(value, 'dtype')
-                            else value.dtype)}
+                            else value.dtype),
+               'gen': gen}
         spec, _mesh = _sharding_of(value)
         if spec is not None:
             rec['spec'] = spec
+            # the record replaces this process's previous one wholesale:
+            # the current addressable set IS this host's complete view,
+            # and unioning with stale own records would let an old block
+            # survive a shard-ownership change (mixing generations)
             rec['shards'] = _save_sharded(dirname, name, value)
         else:
-            np.save(os.path.join(dirname, _safe(name) + '.npy'),
-                    np.asarray(value))
+            # replicated vars: every host writes the same <name>.npy with
+            # identical content; atomicity makes the race benign
+            _atomic_save(os.path.join(dirname, _safe(name) + '.npy'),
+                         value)
         manifest['vars'][name] = rec
-    with open(os.path.join(dirname, _MANIFEST), 'w') as f:
+    _write_manifest(dirname, manifest)
+
+
+def _own_manifest_name():
+    """This process's manifest filename: ``__manifest__.json`` on a single
+    process, ``__manifest__.p<K>.json`` per process on multi-host."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return '__manifest__.p%d.json' % jax.process_index()
+    except Exception:
+        pass
+    return _MANIFEST
+
+
+def _write_manifest(dirname, manifest):
+    """Each JAX process writes only its own manifest file (no cross-host
+    write collision); _read_manifest merges them, unioning the shard
+    lists, so the checkpoint is complete once every host has written —
+    without any barrier or designated writer.  The write is tmp+rename so
+    a concurrent reader (another host seeding its own save) never sees a
+    truncated JSON.  A single-process save claims the directory: stale
+    per-process manifests from an earlier multi-host run into the same
+    dirname are removed, so their shard records can't shadow the fresh
+    save at load time."""
+    import glob
+    fname = _own_manifest_name()
+    path = os.path.join(dirname, fname)
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
         json.dump(manifest, f)
+    os.replace(tmp, path)
+    if fname == _MANIFEST:
+        for stale in glob.glob(os.path.join(glob.escape(dirname),
+                                            '__manifest__.p*.json')):
+            os.remove(stale)
 
 
-def _read_manifest(dirname):
-    path = os.path.join(dirname, _MANIFEST)
-    if not os.path.exists(path):
-        return None
-    with open(path) as f:
-        m = json.load(f)
-    if m.get('format_version', 0) > _FORMAT_VERSION:
-        raise ValueError(
-            "checkpoint %s was written by a newer format (version %s > %s)"
-            % (dirname, m.get('format_version'), _FORMAT_VERSION))
-    return m
+def _read_manifest(dirname, own_only=False):
+    """Read and merge every manifest in the directory: the single-process
+    ``__manifest__.json`` plus any per-process ``__manifest__.p<K>.json``
+    from a multi-host save.  Per-var conflicts resolve by the records'
+    save-generation counter (higher gen wins wholesale; equal gens union
+    shard lists — see _merge_var_record); mtime ordering is only the
+    fallback for gen ties and legacy records.  Nothing raises here; an
+    incomplete winner still fails loudly in _assemble.  ``own_only``
+    restricts to this process's own file (save-time seeding)."""
+    import glob
+    if own_only:
+        paths = [os.path.join(dirname, _own_manifest_name())]
+        paths = [p for p in paths if os.path.exists(p)]
+    else:
+        paths = sorted(
+            glob.glob(os.path.join(glob.escape(dirname),
+                                   '__manifest__*.json')),
+            key=lambda p: (os.path.getmtime(p), p))
+    merged = None
+    for path in paths:
+        with open(path) as f:
+            m = json.load(f)
+        if m.get('format_version', 0) > _FORMAT_VERSION:
+            raise ValueError(
+                "checkpoint %s was written by a newer format "
+                "(version %s > %s)"
+                % (dirname, m.get('format_version'), _FORMAT_VERSION))
+        if merged is None:
+            merged = m
+            continue
+        for name, rec in m.get('vars', {}).items():
+            merged['vars'][name] = _merge_var_record(
+                merged['vars'].get(name), rec, name)
+    return merged
 
 
 def save_params(executor, dirname, main_program=None):
@@ -165,10 +321,16 @@ def _load_sharded(dirname, name, rec):
     reads only the shards it needs); otherwise the full numpy array."""
     shape = tuple(rec['shape'])
     dtype = np.dtype(rec['dtype'])
+    def _shard_path(s):
+        # format v1 wrote integer counters ('x.shard3.npy'); current
+        # format records the index-derived filename directly.
+        if isinstance(s['file'], int):
+            return os.path.join(
+                dirname, '%s.shard%d.npy' % (_safe(name), s['file']))
+        return os.path.join(dirname, s['file'])
+
     shard_files = {
-        tuple(tuple(p) for p in s['index']):
-            os.path.join(dirname, '%s.shard%d.npy' % (_safe(name),
-                                                      s['file']))
+        tuple(tuple(p) for p in s['index']): _shard_path(s)
         for s in rec['shards']}
 
     def piece(index):
@@ -207,10 +369,42 @@ def _np_load(path, dtype):
 
 
 def _assemble(shape, dtype, shard_files):
+    """Stitch shard blocks into the full array, verifying they tile it
+    exactly: in-bounds, pairwise disjoint, and total volume == the full
+    volume (blocks within bounds + disjoint + volumes summing to the
+    whole is equivalent to gap-free coverage).  A partial checkpoint —
+    e.g. one host of a multi-host save missing — raises instead of
+    returning uninitialized memory."""
     full = np.empty(shape, dtype=dtype)
-    for idx, path in shard_files.items():
-        sl = tuple(slice(a, b) for a, b in idx)
-        full[sl] = _np_load(path, dtype)
+    covered = 0
+    blocks = list(shard_files.items())
+    for i, (idx, path) in enumerate(blocks):
+        if len(idx) != len(shape) or any(
+                not (0 <= a <= b <= dim)
+                for (a, b), dim in zip(idx, shape)):
+            raise ValueError(
+                "checkpoint shard %s has index %s outside shape %s"
+                % (os.path.basename(path), idx, shape))
+        for jdx, other in blocks[:i]:
+            if _blocks_overlap(idx, jdx):
+                raise ValueError(
+                    "checkpoint shards %s and %s overlap (indices %s, %s)"
+                    % (os.path.basename(path), os.path.basename(other),
+                       idx, jdx))
+        block = _np_load(path, dtype)
+        want = tuple(b - a for a, b in idx)
+        if block.shape != want:
+            raise ValueError(
+                "checkpoint shard %s has shape %s but its index %s spans "
+                "%s" % (os.path.basename(path), block.shape, idx, want))
+        full[tuple(slice(a, b) for a, b in idx)] = block
+        covered += int(np.prod(want))
+    total = int(np.prod(shape))
+    if covered != total:
+        raise ValueError(
+            "checkpoint shards cover %d of %d elements — the checkpoint "
+            "is incomplete (a host's shards or manifest are missing)"
+            % (covered, total))
     return full
 
 

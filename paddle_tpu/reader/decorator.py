@@ -34,21 +34,25 @@ def map_readers(func, *readers):
 
 
 def shuffle(reader, buf_size):
-    """Shuffle within a sliding buffer of ``buf_size`` samples."""
+    """Shuffle within a sliding window of ``buf_size`` samples.
+
+    The stream is consumed in windows of ``buf_size``; each window is
+    permuted (module-level ``random``, so ``random.seed`` controls it)
+    and drained before the next window is pulled.  Windowing via
+    ``itertools.islice`` keeps at most one window resident.
+    """
 
     def data_reader():
-        buf = []
-        for e in reader():
-            buf.append(e)
-            if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for b in buf:
-                    yield b
-                buf = []
-        if buf:
-            random.shuffle(buf)
-            for b in buf:
-                yield b
+        it = iter(reader())
+        if buf_size <= 0:  # degenerate window: plain pass-through
+            yield from it
+            return
+        while True:
+            window = list(itertools.islice(it, buf_size))
+            if not window:
+                return
+            random.shuffle(window)
+            yield from window
 
     return data_reader
 
@@ -125,13 +129,10 @@ def buffered(reader, size):
 
 
 def firstn(reader, n):
-    """Only the first ``n`` samples."""
+    """Truncate the stream after ``n`` samples (``itertools.islice``)."""
 
     def firstn_reader():
-        for i, item in enumerate(reader()):
-            if i == n:
-                break
-            yield item
+        return itertools.islice(reader(), n)
 
     return firstn_reader
 
@@ -153,6 +154,13 @@ def cache(reader):
 
 class XmapEndSignal(object):
     pass
+
+
+class _XmapError(object):
+    """A mapper exception in transit from a worker to the consumer."""
+
+    def __init__(self, error):
+        self.error = error
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
@@ -183,20 +191,54 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def handle_worker(in_q, out_q, mapper):
         sample = in_q.get()
         while not isinstance(sample, XmapEndSignal):
-            out_q.put(mapper(sample))
+            try:
+                result = mapper(sample)
+            except BaseException as e:
+                # surface the mapper error in the consumer instead of
+                # dying silently (which would leave out_q one EndSignal
+                # short and hang the reader).  The error goes out FIRST —
+                # the consumer always drains out_q, while in_q may be
+                # full with no other drainer (a blocking put there could
+                # deadlock); waking peers is best-effort.
+                out_q.put(_XmapError(e))
+                try:
+                    in_q.put_nowait(end)
+                except _queue.Full:
+                    pass
+                return
+            out_q.put(result)
             sample = in_q.get()
         in_q.put(end)
         out_q.put(end)
 
-    def order_handle_worker(in_q, out_q, mapper, out_order):
+    def order_handle_worker(in_q, out_q, mapper, turn):
+        # ``turn`` is (Condition, [next_index]): a worker may emit its
+        # result only when its sample index is the next one due, so the
+        # output order matches the input order without a spin-wait.
+        cond, nxt = turn
         ins = in_q.get()
         while not isinstance(ins, XmapEndSignal):
             order, sample = ins
-            result = mapper(sample)
-            while order != out_order[0]:
-                pass
-            out_q.put(result)
-            out_order[0] += 1
+            try:
+                result = mapper(sample)
+            except BaseException as e:
+                # still take our turn (so peers blocked on nxt don't
+                # wait forever), then surface the error
+                with cond:
+                    cond.wait_for(lambda: nxt[0] == order)
+                    out_q.put(_XmapError(e))
+                    nxt[0] += 1
+                    cond.notify_all()
+                try:
+                    in_q.put_nowait(end)
+                except _queue.Full:
+                    pass
+                return
+            with cond:
+                cond.wait_for(lambda: nxt[0] == order)
+                out_q.put(result)
+                nxt[0] += 1
+                cond.notify_all()
             ins = in_q.get()
         in_q.put(end)
         out_q.put(end)
@@ -204,13 +246,13 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def xreader():
         in_q = _queue.Queue(buffer_size)
         out_q = _queue.Queue(buffer_size)
-        out_order = [0]
+        turn = (threading.Condition(), [0])
         target = order_read_worker if order else read_worker
         t = threading.Thread(target=target, args=(reader, in_q))
         t.daemon = True
         t.start()
         target = order_handle_worker if order else handle_worker
-        args = (in_q, out_q, mapper, out_order) if order else \
+        args = (in_q, out_q, mapper, turn) if order else \
             (in_q, out_q, mapper)
         workers = []
         for _ in range(process_num):
@@ -223,6 +265,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             sample = out_q.get()
             if isinstance(sample, XmapEndSignal):
                 finish += 1
+            elif isinstance(sample, _XmapError):
+                raise sample.error
             else:
                 yield sample
 
@@ -243,30 +287,28 @@ class PipeReader(object):
             import zlib
             self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
 
+    def _decode(self, raw):
+        if self.file_type == "gzip":
+            raw = self.dec.decompress(raw)
+        elif self.file_type != "plain":
+            raise TypeError("file_type %s is not allowed" % self.file_type)
+        return raw.decode('utf-8', 'ignore')
+
     def get_line(self, cut_lines=True, line_break="\n"):
         self.process = subprocess.Popen(
             self.command.split(" "), bufsize=self.bufsize,
             stdout=subprocess.PIPE)
-        remained = ""
-        while True:
-            buff = self.process.stdout.read(self.bufsize)
-            if buff:
-                if self.file_type == "gzip":
-                    decomp_buff = self.dec.decompress(buff).decode('utf-8',
-                                                                   'ignore')
-                elif self.file_type == "plain":
-                    decomp_buff = buff.decode('utf-8', 'ignore')
-                else:
-                    raise TypeError("file_type %s is not allowed" %
-                                    self.file_type)
-                if cut_lines:
-                    lines = (remained + decomp_buff).split(line_break)
-                    remained = lines.pop(-1)
-                    for line in lines:
-                        yield line
-                else:
-                    yield decomp_buff
-            else:
-                if remained:
-                    yield remained
-                break
+        # Pull fixed-size chunks until EOF (read() returns b'').
+        chunks = iter(lambda: self.process.stdout.read(self.bufsize), b'')
+        if not cut_lines:
+            for raw in chunks:
+                yield self._decode(raw)
+            return
+        pending = ""
+        for raw in chunks:
+            pending += self._decode(raw)
+            complete, sep, pending = pending.rpartition(line_break)
+            if sep:
+                yield from complete.split(line_break)
+        if pending:
+            yield pending

@@ -195,3 +195,63 @@ def test_run_steps_stacked_ragged_feeds_match_run_loop():
     got = exe.run_steps(main, feed=batches, fetch_list=[loss])[0]
     np.testing.assert_allclose(np.ravel(got), want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_run_steps_inconsistent_feed_keys_named():
+    """ADVICE r3: K feed dicts with different key sets fail with an error
+    naming the step and the missing/extra keys, not an opaque scan-shape
+    mismatch."""
+    import pytest
+
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[2], dtype='float32')
+        fluid.layers.elementwise_add(x=x, y=y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    a = np.ones((3, 2), 'float32')
+    feeds = [{'x': a, 'y': a}, {'x': a}]
+    with pytest.raises(ValueError, match=r"step 1 is missing \['y'\]"):
+        exe.run_steps(main, feed=feeds, fetch_list=[])
+
+
+def test_run_steps_out_only_state_single_copy():
+    """ADVICE r3: out-only persistables (written, never read — e.g. a
+    metric accumulator snapshot) ride the scan carry; the value after
+    run_steps(K) equals the K-th run() value."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 5
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[4],
+                                      dtype='float32')
+                h = fluid.layers.fc(input=x, size=4)
+                loss = fluid.layers.mean(x=fluid.layers.square(x=h))
+                fluid.optimizer.SGDOptimizer(
+                    learning_rate=0.1).minimize(loss)
+                snap = fluid.layers.assign(loss)
+                snap.persistable = True
+        return main, startup, loss, snap
+
+    rng = np.random.RandomState(2)
+    batches = [{'x': rng.randn(4, 4).astype('float32')}
+               for _ in range(3)]
+
+    main, startup, loss, snap = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for f in batches:
+        exe.run(main, feed=f, fetch_list=[loss])
+    want = np.asarray(fluid.global_scope().find_var(snap.name))
+
+    main, startup, loss, snap = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run_steps(main, feed=batches, fetch_list=[loss])
+    got = np.asarray(fluid.global_scope().find_var(snap.name))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -548,3 +548,58 @@ def test_sharded_run_steps_matches_run_loop():
                            repeat=3)[0]
     np.testing.assert_allclose(np.ravel(got_rep), want_rep, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_parallel_do_run_steps_under_mesh():
+    """ADVICE r3: run_steps applies the same mesh staging as run() for a
+    parallel_do program — K scanned steps under a mesh_guard match K
+    run() calls exactly."""
+    need_devices(8)
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 31
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[6],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                pd = fluid.layers.ParallelDo(
+                    fluid.layers.get_places(device_count=8))
+                with pd.do():
+                    pd.read_input(x)
+                    pd.read_input(y)
+                    h = fluid.layers.fc(input=x, size=8, act='tanh')
+                    pred = fluid.layers.fc(input=h, size=1)
+                    pd.write_output(fluid.layers.mean(
+                        x=fluid.layers.square_error_cost(input=pred,
+                                                         label=y)))
+                loss = fluid.layers.mean(x=pd())
+                fluid.optimizer.SGDOptimizer(
+                    learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(6)
+    w = rng.randn(6, 1).astype('float32')
+    batches = [{'x': (xb := rng.randn(16, 6).astype('float32')),
+                'y': xb @ w} for _ in range(3)]
+
+    mesh = api.make_mesh((8,), ('dp',))
+
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with api.mesh_guard(mesh):
+        want = [float(np.ravel(exe.run(main, feed=f,
+                                       fetch_list=[loss])[0])[0])
+                for f in batches]
+
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with api.mesh_guard(mesh):
+        got = exe.run_steps(main, feed=batches, fetch_list=[loss])[0]
+    np.testing.assert_allclose(np.ravel(got), want, rtol=1e-5,
+                               atol=1e-6)

@@ -140,3 +140,52 @@ def test_recordio_roundtrip(tmp_path):
             w.write(b'payload-%d' % i)
     got = [r for r in RecordReader(p)]
     assert got == [b'payload-%d' % i for i in range(10)]
+
+
+def test_xmap_mapper_error_propagates():
+    """A mapper exception surfaces in the consumer (both ordered and
+    unordered paths) instead of hanging the reader."""
+    import pytest
+
+    from paddle_tpu.runtime import native as _native
+
+    def bad(x):
+        if x == 5:
+            raise RuntimeError("boom on 5")
+        return x
+
+    # exercise the pure-python fallback even when the native queue built
+    orig = _native.available
+    _native.available = lambda: False
+    try:
+        for order in (False, True):
+            r = rd.xmap_readers(bad, counter(16), 3, 4, order=order)
+            with pytest.raises(RuntimeError, match="boom on 5"):
+                list(r())
+    finally:
+        _native.available = orig
+    if orig():  # and the native path, when present
+        for order in (False, True):
+            r = rd.xmap_readers(bad, counter(16), 3, 4, order=order)
+            with pytest.raises(RuntimeError, match="boom on 5"):
+                list(r())
+
+
+def test_xmap_single_worker_full_queue_error():
+    """Code-review r4: one worker, input queue full (reader outpaces the
+    mapper) — the error must still reach the consumer, not deadlock on a
+    blocking in_q.put."""
+    import pytest
+    from paddle_tpu.runtime import native as _native
+
+    def bad(x):
+        raise RuntimeError("always fails")
+
+    orig = _native.available
+    _native.available = lambda: False
+    try:
+        r = rd.xmap_readers(bad, counter(100), 1, 2, order=False)
+        with pytest.raises(RuntimeError, match="always fails"):
+            list(r())
+    finally:
+        _native.available = orig
