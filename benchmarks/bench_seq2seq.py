@@ -37,7 +37,7 @@ def main():
                 'target_language_next_word': mk()}
 
     run_bench('seq2seq_attention_tokens_per_sec', batch * seq, build,
-              feed, steps=10 if on_tpu() else 3,
+              feed, steps=100 if on_tpu() else 3,
               note='batch=%d seq=%d vocab=%d dim=%d' % (batch, seq,
                                                         vocab, dim),
               dtype='bfloat16')

@@ -400,42 +400,22 @@ class Executor(object):
 
         block = program.global_block()
 
-        dev = self.place.jax_device()
-        # A program with a parallel_do op lowers to a shard_map over the
-        # active mesh; its jit then spans the mesh's devices, so every
-        # argument must be placed replicated on the mesh (the reference
-        # analogue: the host drives the program, only parallel_do fans
-        # out to places).  Single-device placement would make jit reject
-        # the mixed device sets.
-        mesh = self._active_mesh(program)
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            dev = NamedSharding(mesh, PartitionSpec())
+        mesh, dev = self._mesh_and_dev(program)
         feed_arrays = {}
         for name, value in feed.items():
             var = block.vars.get(name)
             feed_arrays.update(_to_feed_arrays(name, value, var))
-        # Commit feeds explicitly: an async device_put is ~10x faster than
-        # letting jit transfer numpy args in-line, and committed inputs pin
-        # the computation to `place` without a jax.default_device context
-        # (which defeats jit's C++ fast-path dispatch — measured 9.7s/step
-        # vs 60ms on a tunneled v5e).
-        feed_arrays = {k: (v if isinstance(v, jax.Array) and mesh is None
-                           else jax.device_put(v, dev))
-                       for k, v in feed_arrays.items()}
+        feed_arrays = self._stage_feed(feed_arrays, mesh, dev)
 
         plan = self._get_plan(program, block, scope, feed_arrays,
                               tuple(fetch_names), use_program_cache,
                               mesh=mesh)
         (fn, _raw, state_rw_names, state_ro_names) = plan
 
-        state_rw = {n: scope.get(n) for n in state_rw_names}
-        state_ro = {n: scope.get(n) for n in state_ro_names}
-        if mesh is not None:
-            state_rw = {n: jax.device_put(v, dev)
-                        for n, v in state_rw.items()}
-            state_ro = {n: jax.device_put(v, dev)
-                        for n, v in state_ro.items()}
+        state_rw = self._stage_state(
+            {n: scope.get(n) for n in state_rw_names}, mesh, dev)
+        state_ro = self._stage_state(
+            {n: scope.get(n) for n in state_ro_names}, mesh, dev)
         rng_key = jax.device_put(self._rng_key(program), dev)
         self._step += 1
 
@@ -448,6 +428,39 @@ class Executor(object):
         return fetches
 
     # ------------------------------------------------------------------
+    def _mesh_and_dev(self, program):
+        """(mesh, placement) for a program: a program with a parallel_do
+        op lowers to a shard_map over the active mesh; its jit then
+        spans the mesh's devices, so every argument must stage
+        replicated on the mesh (the reference analogue: the host drives
+        the program, only parallel_do fans out to places).  The single
+        home of the mesh-staging rule shared by run() and run_steps()."""
+        mesh = self._active_mesh(program)
+        dev = self.place.jax_device()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            dev = NamedSharding(mesh, PartitionSpec())
+        return mesh, dev
+
+    @staticmethod
+    def _stage_feed(feed_arrays, mesh, dev):
+        """Commit feeds explicitly: an async device_put is ~10x faster
+        than letting jit transfer numpy args in-line, and committed
+        inputs pin the computation to the place without a
+        jax.default_device context (which defeats jit's C++ fast-path
+        dispatch — measured 9.7s/step vs 60ms on a tunneled v5e).
+        Already-staged jax.Arrays pass through untouched unless a mesh
+        requires re-placement."""
+        return {k: (v if isinstance(v, jax.Array) and mesh is None
+                    else jax.device_put(v, dev))
+                for k, v in feed_arrays.items()}
+
+    @staticmethod
+    def _stage_state(state, mesh, dev):
+        if mesh is None:
+            return state
+        return {n: jax.device_put(v, dev) for n, v in state.items()}
+
     def _active_mesh(self, program):
         """The current mesh_guard mesh, when `program` contains an op
         that fans out over it (parallel_do) and the mesh is >1 device."""
@@ -606,21 +619,12 @@ class Executor(object):
                                 else '',
                                 "adds %s" % extra if extra else '']))))
 
-        dev = self.place.jax_device()
-        # Mirror run(): a parallel_do program traced under a mesh_guard
-        # spans the mesh's devices, so feeds/state/key stage replicated on
-        # the mesh and the mesh keys both plan caches.
-        mesh = self._active_mesh(program)
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            dev = NamedSharding(mesh, PartitionSpec())
+        mesh, dev = self._mesh_and_dev(program)
         feed0 = {}
         for name, value in feeds[0].items():
             var = block.vars.get(name)
             feed0.update(_to_feed_arrays(name, value, var))
-        feed0 = {n: (v if isinstance(v, jax.Array) and mesh is None
-                     else jax.device_put(v, dev))
-                 for n, v in feed0.items()}
+        feed0 = self._stage_feed(feed0, mesh, dev)
 
         fn_plan = self._get_plan(program, block, scope, feed0,
                                  fetch_names, True, mesh=mesh)
@@ -650,13 +654,10 @@ class Executor(object):
             xs = {n: jax.device_put(_stack_feed_col(n, vs), dev)
                   for n, vs in cols.items()}
 
-        state_rw = {n: scope.get(n) for n in rw_names}
-        state_ro = {n: scope.get(n) for n in ro_names}
-        if mesh is not None:
-            state_rw = {n: jax.device_put(v, dev)
-                        for n, v in state_rw.items()}
-            state_ro = {n: jax.device_put(v, dev)
-                        for n, v in state_ro.items()}
+        state_rw = self._stage_state(
+            {n: scope.get(n) for n in rw_names}, mesh, dev)
+        state_ro = self._stage_state(
+            {n: scope.get(n) for n in ro_names}, mesh, dev)
         key0 = jax.device_put(
             jax.random.PRNGKey(self._base_seed(program)), dev)
         t0 = jnp.asarray(self._step, jnp.int32)

@@ -160,28 +160,31 @@ def _merge_var_record(old, new, name):
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None):
+              predicate=None, generation=None):
     if vars is None:
         if main_program is None:
             main_program = default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
     os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
-    # Seed from THIS process's previous manifest only — merging siblings
-    # here would copy other hosts' shard records into our manifest, and a
-    # torn later checkpoint (another host crashing mid-save) would then
-    # pass the load-time completeness check on our stale copy of its
-    # records.
+    # Seed var records from THIS process's previous manifest only —
+    # copying siblings' shard records into our manifest would let a torn
+    # later checkpoint (another host crashing mid-save) pass the
+    # load-time completeness check on our stale copy of its records.
     manifest = _read_manifest(dirname, own_only=True) or {
         'format_version': _FORMAT_VERSION, 'vars': {}}
-    # Save generation: one past the newest this process has written into
-    # this directory.  Hosts of one multi-host save share checkpoint
-    # history, so they compute the SAME value independently — the merge
-    # key that lets _read_manifest tell sibling writers (equal gen, union
-    # shards) from a stale generation (lower gen, dropped) without
-    # trusting filesystem mtimes.
-    gen = 1 + max([r.get('gen', 0) for r in manifest['vars'].values()]
-                  + [0])
+    if generation is None:
+        # Save generation: one past the newest in the WHOLE directory
+        # (all manifests — a process's own history alone diverges when
+        # the host count changes between runs, and a stale higher-gen
+        # sibling record would then shadow this save at load).  Hosts of
+        # one synchronized save read the same history and agree; callers
+        # with a natural logical clock (save_checkpoint's step) pass it
+        # as `generation`, which is immune even to save-vs-save races.
+        merged = _read_manifest(dirname)
+        recs = merged['vars'].values() if merged else []
+        generation = 1 + max([r.get('gen', 0) for r in recs] + [0])
+    gen = int(generation)
     for var in vars:
         name = var.name if isinstance(var, Variable) else var
         value = scope.find_var(name)
@@ -280,14 +283,15 @@ def _read_manifest(dirname, own_only=False):
     return merged
 
 
-def save_params(executor, dirname, main_program=None):
+def save_params(executor, dirname, main_program=None, generation=None):
     save_vars(executor, dirname, main_program, vars=None,
-              predicate=is_parameter)
+              predicate=is_parameter, generation=generation)
 
 
-def save_persistables(executor, dirname, main_program=None):
+def save_persistables(executor, dirname, main_program=None,
+                      generation=None):
     save_vars(executor, dirname, main_program, vars=None,
-              predicate=is_persistable)
+              predicate=is_persistable, generation=generation)
 
 
 def _check_against_program(name, var, shape, dtype):
@@ -516,8 +520,11 @@ def get_parameter_value_by_name(name, executor=None, program=None):
 # -- checkpoint/resume (SURVEY.md A2) ------------------------------------
 def save_checkpoint(executor, dirname, main_program=None, step=None):
     """Full training state: every persistable (params + optimizer moments +
-    bn stats + counters)."""
-    save_persistables(executor, dirname, main_program)
+    bn stats + counters).  ``step`` doubles as the save-generation logical
+    clock: every host of a synchronized save passes the same step, so the
+    manifest merge is race-free even across host-count changes."""
+    save_persistables(executor, dirname, main_program,
+                      generation=None if step is None else int(step) + 1)
     if step is not None:
         with open(os.path.join(dirname, 'STEP'), 'w') as f:
             f.write(str(int(step)))

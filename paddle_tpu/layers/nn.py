@@ -12,7 +12,8 @@ from .layer_helper import LayerHelper
 __all__ = [
     'fc', 'embedding', 'conv2d', 'conv3d', 'pool2d', 'pool3d', 'batch_norm',
     'layer_norm', 'dropout', 'cross_entropy', 'square_error_cost',
-    'accuracy', 'softmax_with_cross_entropy', 'conv2d_transpose',
+    'accuracy', 'softmax_with_cross_entropy', 'fused_linear_softmax_ce',
+    'conv2d_transpose',
     'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min', 'reduce_prod',
     'split', 'matmul', 'topk', 'l2_normalize', 'one_hot', 'cos_sim', 'lrn',
     'warpctc', 'nce', 'bilinear_tensor_product', 'prelu', 'pad',
@@ -365,6 +366,42 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, **kwargs):
         inputs={'Logits': [logits], 'Label': [label]},
         outputs={'Softmax': [softmax], 'Loss': [loss]},
         attrs={'soft_label': soft_label})
+    return loss
+
+
+def fused_linear_softmax_ce(input, label, size, num_flatten_dims=1,
+                            param_attr=None, bias_attr=None, chunk=4096,
+                            mode='auto', **kwargs):
+    """Vocab projection + softmax cross-entropy as ONE chunked op: the
+    [N, size] logits never materialize in HBM (ops/chunked_ce.py).  The
+    TPU-first form of ``fc(size=V) → softmax_with_cross_entropy`` for
+    large ``size``; same fp32-master-weight recipe as fc, so a plain fc
+    sharing ``param_attr``/``bias_attr`` names reuses the trained head
+    for inference/decoding."""
+    helper = LayerHelper('fused_linear_softmax_ce', **locals())
+    dtype = helper.input_dtype()
+    p_dtype = 'float32' if dtype in ('bfloat16', 'float16') else dtype
+    input_shape = input.shape
+    flatten = num_flatten_dims
+    if input.lod_level > 0 and num_flatten_dims == 1:
+        flatten = len(input_shape) - 1
+    w = helper.create_parameter(
+        attr=param_attr, shape=[_prod(input_shape[flatten:]), size],
+        dtype=p_dtype, is_bias=False)
+    inputs = {'X': [input], 'W': [w], 'Label': [label]}
+    if bias_attr is not False:
+        from ..param_attr import ParamAttr
+        battr = bias_attr if bias_attr is not None else ParamAttr()
+        b = helper.create_parameter(attr=battr, shape=[size],
+                                    dtype=p_dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    loss = helper.create_tmp_variable('float32')
+    helper.append_op(
+        type='fused_linear_softmax_ce', inputs=inputs,
+        outputs={'Loss': [loss]},
+        attrs={'chunk': int(chunk), 'mode': mode},
+        infer_shape=False)
+    loss.shape = tuple(input_shape[:flatten]) + (1,)
     return loss
 
 

@@ -72,18 +72,23 @@ def _enc_proj(encoded, hidden_dim):
                            bias_attr=_attr('mt_enc_proj_b'))
 
 
-def _attend_logits(dec_states, encoded, enc_proj, dict_size):
-    """Shared attention + vocab head up to the fp32 LOGITS: dec_states
-    [B, Td|K, H] against the padded encoder states — Luong scores,
-    masked softmax, context concat, vocab fc.  Used verbatim by BOTH the
-    teacher-forced train path and the per-step beam decode so the two
-    can never drift.  Under bf16 activations the vocab matmul runs bf16
-    and only what follows the logits is fp32."""
+def _attend_combined(dec_states, encoded, enc_proj):
+    """Shared Luong attention: dec_states [B, Td|K, H] against the
+    padded encoder states — scores, masked softmax, context concat.
+    Used verbatim by BOTH the teacher-forced train path and the per-step
+    beam decode so the two can never drift."""
     scores = fluid.layers.matmul(dec_states, enc_proj, transpose_y=True)
     attn = fluid.layers.sequence_softmax(
         input=scores, length_input=encoded, axis=2)
     context = fluid.layers.matmul(attn, encoded)
-    combined = fluid.layers.concat(input=[dec_states, context], axis=2)
+    return fluid.layers.concat(input=[dec_states, context], axis=2)
+
+
+def _attend_logits(dec_states, encoded, enc_proj, dict_size):
+    """Attention + vocab head up to the fp32 LOGITS.  Under bf16
+    activations the vocab matmul runs bf16 and only what follows the
+    logits is fp32."""
+    combined = _attend_combined(dec_states, encoded, enc_proj)
     logits = fluid.layers.fc(
         input=combined, size=dict_size, num_flatten_dims=2, act=None,
         param_attr=_attr('mt_out_fc_w'), bias_attr=_attr('mt_out_fc_b'))
@@ -98,7 +103,7 @@ def _attend_and_score(dec_states, encoded, enc_proj, dict_size):
 
 
 def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32,
-              dtype='float32'):
+              dtype='float32', fuse_vocab_loss=True):
     encoded = encoder(src, dict_size, word_dim, hidden_dim, dtype=dtype)
     dec_h0 = _decoder_init(encoded, hidden_dim)
 
@@ -116,20 +121,36 @@ def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32,
 
     # Luong attention: scores over padded encoder states, masked softmax
     enc_proj = _enc_proj(encoded, hidden_dim)
-    logits = _attend_logits(dec_out, encoded, enc_proj, dict_size)
-    # prediction kept for parity consumers (fetch/inference); the LOSS
-    # rides the fused softmax_with_cross_entropy so backward is one
-    # (softmax - onehot) pass — cross_entropy(softmax(x)) differentiates
-    # through log and divide, which profiled at ~1/2 the seq2seq step
+    combined = _attend_combined(dec_out, encoded, enc_proj)
+    # prediction kept for parity consumers (fetch/inference) — when only
+    # the loss is fetched XLA dead-code-eliminates this whole branch
+    logits = fluid.layers.fc(
+        input=combined, size=dict_size, num_flatten_dims=2, act=None,
+        param_attr=_attr('mt_out_fc_w'), bias_attr=_attr('mt_out_fc_b'))
+    if logits.dtype in ('bfloat16', 'float16'):
+        logits = fluid.layers.cast(x=logits, dtype='float32')
     prediction = fluid.layers.softmax(x=logits)
-    cost = fluid.layers.softmax_with_cross_entropy(logits=logits,
-                                                   label=label)
+    if fuse_vocab_loss:
+        # TPU-first loss: vocab projection + softmax-CE in one chunked
+        # op — the [B·T, dict_size] logits never reach HBM (the same
+        # head params as the fc above, so decode/inference reuse the
+        # trained weights).  ops/chunked_ce.py has the analysis.
+        cost = fluid.layers.fused_linear_softmax_ce(
+            input=combined, label=label, size=dict_size,
+            num_flatten_dims=2, param_attr=_attr('mt_out_fc_w'),
+            bias_attr=_attr('mt_out_fc_b'))
+    else:
+        # dense reference path: fused softmax_with_cross_entropy on the
+        # materialized logits (backward = one softmax − onehot pass)
+        cost = fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                       label=label)
     avg_cost = fluid.layers.mean(
         x=fluid.layers.sequence_pool(input=cost, pool_type='sum'))
     return prediction, avg_cost
 
 
-def build(dict_size, word_dim=32, hidden_dim=32, dtype='float32'):
+def build(dict_size, word_dim=32, hidden_dim=32, dtype='float32',
+          fuse_vocab_loss=True):
     """Returns (src, trg, label, prediction, avg_cost).  dtype='bfloat16'
     runs embeddings/projections/GRU gates/vocab head in bf16 with fp32
     master weights; the softmax and loss stay fp32."""
@@ -140,7 +161,8 @@ def build(dict_size, word_dim=32, hidden_dim=32, dtype='float32'):
     label = fluid.layers.data(name='target_language_next_word', shape=[1],
                               dtype='int64', lod_level=1)
     prediction, avg_cost = train_net(src, trg, label, dict_size, word_dim,
-                                     hidden_dim, dtype=dtype)
+                                     hidden_dim, dtype=dtype,
+                                     fuse_vocab_loss=fuse_vocab_loss)
     return src, trg, label, prediction, avg_cost
 
 

@@ -3,7 +3,8 @@
 Reference parity: paddle/operators/* (one jax function per reference op
 kernel family; see SURVEY.md §2.2).
 """
-from . import (activations, attention, beam_search, collective_ops,
-               common, control_flow, conv, crf, ctc, detection, embedding,
-               loss, math, metrics, misc, norm, optim_ops, pool, random,
-               rnn, sequence, tensor_array, tensor_ops)  # noqa: F401
+from . import (activations, attention, beam_search, chunked_ce,
+               collective_ops, common, control_flow, conv, crf, ctc,
+               detection, embedding, loss, math, metrics, misc, norm,
+               optim_ops, pool, random, rnn, sequence, tensor_array,
+               tensor_ops)  # noqa: F401

@@ -1,0 +1,210 @@
+"""Chunked fused vocab-projection + softmax cross-entropy.
+
+Reference parity: the loss half of operators/softmax_with_cross_entropy_op.cc
+composed with the vocab fc (mul_op) — but computed ONLINE over vocab
+chunks so the [N, V] logits matrix never reaches HBM.  For a 30k vocab
+at batch·seq = 8192 the dense path writes (and backward re-reads) a
+~1 GB fp32 logits buffer plus the saved softmax; this op's forward is
+one matmul stream with a running (max, sumexp, label-logit) triple, and
+its backward recomputes each chunk's logits to form softmax−onehot on
+the fly — the same recompute-instead-of-store trade the flash-attention
+kernel makes, applied to the classifier head.
+
+FLOP cost: 4 N·D·V matmul passes (logits, logits-recompute, dx, dW)
+vs 3 for the dense path; HBM savings: ~2×N·V fp32 reads+writes.  Net
+win whenever V is large enough that the logits don't fit cache — the
+regime the vocab head lives in.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import first
+
+_DEF_CHUNK = 4096
+
+
+def _pad_to_multiple(v, c):
+    return ((v + c - 1) // c) * c
+
+
+def _chunk_logits(x, wp, bp, i, chunk, out_dtype=jnp.float32):
+    """Logits for vocab chunk i: x @ W[:, iC:(i+1)C] + b, fp32 accum."""
+    wc = lax.dynamic_slice_in_dim(wp, i * chunk, chunk, axis=1)
+    bc = lax.dynamic_slice_in_dim(bp, i * chunk, chunk, axis=0)
+    logits = jnp.matmul(x, wc.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits.astype(out_dtype) + bc.astype(out_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _chunked_linear_ce(x, w, b, lab, chunk):
+    """loss[n] = logsumexp_v(x@w + b)[n] - (x@w + b)[n, lab[n]]."""
+    loss, _ = _chunked_ce_fwd_impl(x, w, b, lab, chunk)
+    return loss
+
+
+def _chunked_ce_fwd_impl(x, w, b, lab, chunk):
+    n, _d = x.shape
+    v = w.shape[1]
+    vp = _pad_to_multiple(v, chunk)
+    nc = vp // chunk
+    # pad bias with -inf-ish so padded columns vanish from the logsumexp
+    wp = jnp.pad(w, ((0, 0), (0, vp - v)))
+    bp = jnp.pad(b, (0, vp - v), constant_values=-1e30)
+
+    def body(carry, i):
+        m, s, ll = carry
+        logits = _chunk_logits(x, wp, bp, i, chunk)  # [N, C] fp32
+        cmax = jnp.max(logits, axis=1)
+        m2 = jnp.maximum(m, cmax)
+        s2 = s * jnp.exp(m - m2) + jnp.sum(
+            jnp.exp(logits - m2[:, None]), axis=1)
+        local = lab - i * chunk
+        hit = (local >= 0) & (local < chunk)
+        lg = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        ll2 = jnp.where(hit, lg, ll)
+        return (m2, s2, ll2), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, ll), _ = lax.scan(body, init, jnp.arange(nc))
+    lse = m + jnp.log(s)
+    return lse - ll, lse
+
+
+def _chunked_ce_fwd(x, w, b, lab, chunk):
+    loss, lse = _chunked_ce_fwd_impl(x, w, b, lab, chunk)
+    return loss, (x, w, b, lab, lse)
+
+
+def _chunked_ce_bwd(chunk, res, g):
+    x, w, b, lab, lse = res
+    n, d = x.shape
+    v = w.shape[1]
+    vp = _pad_to_multiple(v, chunk)
+    nc = vp // chunk
+    wp = jnp.pad(w, ((0, 0), (0, vp - v)))
+    bp = jnp.pad(b, (0, vp - v), constant_values=-1e30)
+    g32 = g.astype(jnp.float32)
+    cols = jnp.arange(chunk)
+
+    def body(dx, i):
+        logits = _chunk_logits(x, wp, bp, i, chunk)
+        p = jnp.exp(logits - lse[:, None])  # softmax slice, fp32
+        # one-hot subtract as a broadcast compare: a scatter here costs
+        # ~18 ms/step on a v5e (slow TPU scatter path); the compare
+        # fuses into the surrounding elementwise for free
+        local = lab - i * chunk
+        p = p - (local[:, None] == cols[None, :]).astype(jnp.float32)
+        dl = p * g32[:, None]              # dLogits chunk [N, C]
+        dlc = dl.astype(x.dtype)           # matmuls ride the activation
+        wc = lax.dynamic_slice_in_dim(wp, i * chunk, chunk, axis=1)
+        dx = dx + jnp.matmul(dlc, wc.astype(x.dtype).T,
+                             preferred_element_type=jnp.float32)
+        dwc = jnp.matmul(x.T, dlc, preferred_element_type=jnp.float32)
+        return dx, (dwc, jnp.sum(dl, axis=0))
+
+    # dW rides the scan OUTPUT (one [nc, D, C] write + one transpose),
+    # not the carry: a dynamic_update_slice on a [D, Vp] carry makes XLA
+    # copy the whole buffer per iteration when aliasing fails
+    dx, (dws, dbs) = lax.scan(body, jnp.zeros((n, d), jnp.float32),
+                              jnp.arange(nc))
+    dw = jnp.moveaxis(dws, 0, 1).reshape(d, vp)[:, :v]
+    db = dbs.reshape(vp)[:v]
+    dlab = np.zeros(lab.shape, dtype=jax.dtypes.float0)
+    return (dx.astype(x.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            dlab)
+
+
+_chunked_linear_ce.defvjp(_chunked_ce_fwd, _chunked_ce_bwd)
+
+
+@jax.custom_vjp
+def _dense_linear_ce(x, w, b, lab):
+    """Dense-mode fused linear+CE: ONE logits matmul whose reductions
+    (max, sumexp, label gather) fuse onto the dot output; the only
+    [N, V] residual is a HALF-WIDTH copy of the logits in the activation
+    dtype (bf16 under mixed precision) for the backward softmax — the
+    fp32 logits, log-softmax, and saved-softmax buffers of the naive
+    composition never exist.  At vocab 30k the bf16 store (~0.6 ms of
+    HBM) beats the chunked mode's recompute matmul (~4 ms of MXU); the
+    chunked mode wins when even the half-width logits don't fit."""
+    loss, _, _ = _dense_ce_fwd_impl(x, w, b, lab)
+    return loss
+
+
+def _dense_ce_fwd_impl(x, w, b, lab):
+    logits = jnp.matmul(x, w.astype(x.dtype),
+                        preferred_element_type=jnp.float32) + b
+    m = jnp.max(logits, axis=1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=1))
+    ll = jnp.take_along_axis(logits, lab[:, None], axis=1)[:, 0]
+    return lse - ll, lse, logits.astype(x.dtype)
+
+
+def _dense_ce_fwd(x, w, b, lab):
+    loss, lse, logits_act = _dense_ce_fwd_impl(x, w, b, lab)
+    return loss, (x, w, b, lab, lse, logits_act)
+
+
+def _dense_ce_bwd(res, g):
+    x, w, b, lab, lse, logits_act = res
+    n = x.shape[0]
+    v = w.shape[1]
+    p = jnp.exp(logits_act.astype(jnp.float32) - lse[:, None])
+    p = p - (lab[:, None] == jnp.arange(v)[None, :]).astype(jnp.float32)
+    dl = p * g.astype(jnp.float32)[:, None]
+    dlc = dl.astype(x.dtype)
+    dx = jnp.matmul(dlc, w.astype(x.dtype).T,
+                    preferred_element_type=jnp.float32)
+    dw = jnp.matmul(x.T, dlc, preferred_element_type=jnp.float32)
+    db = jnp.sum(dl, axis=0)
+    dlab = np.zeros(lab.shape, dtype=jax.dtypes.float0)
+    return (dx.astype(x.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            dlab)
+
+
+_dense_linear_ce.defvjp(_dense_ce_fwd, _dense_ce_bwd)
+
+
+# auto mode switches to the chunked scan once the half-width logits
+# residual would exceed this budget (bytes)
+_DENSE_BYTES_BUDGET = 2 << 30
+
+
+@register_op('fused_linear_softmax_ce')
+def _fused_linear_softmax_ce(ctx, ins, attrs):
+    """X [.., D] → per-position CE loss [.., 1] against Label [.., 1]
+    through the W [D, V] / Bias [V] vocab head.  mode='auto' (default)
+    picks the dense single-matmul VJP while its activation-dtype logits
+    residual fits _DENSE_BYTES_BUDGET, else the chunked scan that never
+    materializes [N, V] at all.  'dense'/'chunked' force a path."""
+    x = first(ins, 'X')
+    w = first(ins, 'W')
+    b = first(ins, 'Bias')
+    label = first(ins, 'Label')
+    chunk = int(attrs.get('chunk', _DEF_CHUNK))
+    mode = attrs.get('mode', 'auto')
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    v = w.shape[1]
+    if b is None:
+        b = jnp.zeros((v,), jnp.float32)
+    lab = label.astype(jnp.int32).reshape(-1)
+    n = int(np.prod(lead)) if lead else 1
+    if mode == 'auto':
+        mode = ('dense' if n * v * x.dtype.itemsize <= _DENSE_BYTES_BUDGET
+                else 'chunked')
+    if mode == 'dense':
+        loss = _dense_linear_ce(x.reshape(-1, d), w, b, lab)
+    else:
+        loss = _chunked_linear_ce(x.reshape(-1, d), w, b, lab, chunk)
+    return {'Loss': [loss.reshape(lead + (1,))]}

@@ -350,6 +350,42 @@ def test_torn_resave_same_tiling_fails_loudly(tmp_path):
         io._load_sharded(d, 'w', merged['vars']['w'])
 
 
+def test_resave_after_topology_change_wins(tmp_path):
+    """Code-review r4: a fresh save by processes with no own manifest in
+    the directory must out-generation stale sibling manifests from an
+    earlier run (gen seeds from the whole directory, not own history) —
+    otherwise the load silently restores the pre-restart weights."""
+    import json
+    import os
+    d = str(tmp_path / 'topo')
+    old = np.zeros((8, 8), dtype='float32')
+    _write_host_manifest(d, 5, old, [(0, 8)], gen=2)  # old single host
+    # emulate the seeding path a brand-new process runs: gen must come
+    # from the merged directory view (3), not from its own (absent)
+    # manifest (1)
+    merged = io._read_manifest(d)
+    gen = 1 + max([r.get('gen', 0) for r in merged['vars'].values()] + [0])
+    assert gen == 3
+    new = np.arange(64, dtype='float32').reshape(8, 8)
+    _write_host_manifest(d, 0, new, [(0, 4)], gen=gen)
+    _write_host_manifest(d, 1, new, [(4, 8)], gen=gen)
+    got = io._load_sharded(d, 'w', io._read_manifest(d)['vars']['w'])
+    np.testing.assert_array_equal(np.asarray(got), new)
+
+
+def test_save_checkpoint_generation_is_step(tmp_path):
+    """save_checkpoint uses the training step as the save-generation
+    logical clock, so synchronized multi-host saves agree without any
+    directory read-back race."""
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / 'stepgen')
+    io.save_checkpoint(exe, d, main, step=7)
+    gens = {r['gen'] for r in io._read_manifest(d)['vars'].values()}
+    assert gens == {8}
+
+
 def test_save_generation_increments(tmp_path):
     """Each save_vars call into a directory bumps the per-record save
     generation (the multi-host merge key)."""
