@@ -34,8 +34,8 @@ def main():
         hw, depth, classes = 224, 50, 1000
         lat_calls, thr_chain = 30, 30
     else:  # CPU smoke: same path, tiny shapes
-        hw, depth, classes = 64, 18, 100
-        lat_calls, thr_chain = 5, 5
+        hw, depth, classes = 32, 18, 10
+        lat_calls, thr_chain = 3, 3
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -48,15 +48,26 @@ def main():
 
     rng = np.random.default_rng(0)
     results = []
-    for batch, mode in ((1, 'latency'), (8, 'latency'), (8, 'throughput'),
-                        (64, 'throughput')):
-        path = os.path.join(tempfile.mkdtemp(), 'resnet_b%d.hlo' % batch)
-        serving.export_inference(path, {'img': (batch, hw, hw, 3)},
-                                 [prediction], executor=exe,
-                                 main_program=main_prog)
-        server = serving.InferenceServer(path)
-        x = rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)
-        np.asarray(server.predict({'img': x})[0])  # warm the executable
+    servers, xs = {}, {}
+    for batch, mode in ((1, 'latency'), (8, 'latency'),
+                        (8, 'throughput'), (64, 'throughput'),
+                        (64, 'pipelined')):
+        server = servers.get(batch)
+        if server is None:
+            path = os.path.join(tempfile.mkdtemp(),
+                                'resnet_b%d.hlo' % batch)
+            serving.export_inference(path, {'img': (batch, hw, hw, 3)},
+                                     [prediction], executor=exe,
+                                     main_program=main_prog)
+            server = servers[batch] = serving.InferenceServer(path)
+            xs[batch] = rng.normal(
+                size=(batch, hw, hw, 3)).astype(np.float32)
+            np.asarray(server.predict({'img': xs[batch]})[0])  # warm
+        x = xs[batch]
+        # pipelined mode re-uploads per call; cap it for big batches
+        # (the tunnel moves ~8-35 MB/s), chained mode stages once
+        thr_chain_b = thr_chain if (batch <= 8 or mode == 'throughput') \
+            else min(thr_chain, 10)
 
         if mode == 'latency':
             times = []
@@ -70,32 +81,68 @@ def main():
                  "unit": "ms", "dtype": "bfloat16"}
             if tpu:
                 r["note"] = "per-call round trip incl. axon tunnel RTT"
+        elif mode == 'throughput':
+            # predict_stacked: K requests as one device scan, one sync —
+            # the serve-path counterpart of Executor.run_steps.  The
+            # stacked inputs stage onto the device ONCE and the upload
+            # is timed separately: a production server overlaps staging
+            # with compute (double buffering), while on this bench box
+            # the host->device path is a tunnel whose bandwidth would
+            # otherwise swamp the measurement.
+            stacked_np = {'img': np.stack([x] * thr_chain_b)}
+            t0 = time.perf_counter()
+            stacked = {kk: jax.device_put(v, place.jax_device())
+                       for kk, v in stacked_np.items()}
+            jax.block_until_ready(stacked['img'])
+            t_upload = time.perf_counter() - t0
+            ys = server.predict_stacked(stacked, thr_chain_b)  # compile
+            [np.asarray(y) for y in ys]
+            samples, totals = [], []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                ys = server.predict_stacked(stacked, thr_chain_b)
+                [np.asarray(y) for y in ys]
+                totals.append(time.perf_counter() - t0)
+                samples.append(batch * thr_chain_b / totals[-1])
+            # split the wall into device vs dispatch: the chained call
+            # pays ONE dispatch for K batches, so per-batch device time
+            # is the chained wall / K; a single predict() pays the full
+            # round trip, and the difference is dispatch cost.  Median
+            # sample, so the breakdown describes the same run as the
+            # reported value.
+            t_chain_batch = float(np.median(totals)) / thr_chain_b * 1e3
+            t0 = time.perf_counter()
+            np.asarray(server.predict({'img': x})[0])
+            t_single = (time.perf_counter() - t0) * 1e3
+            r = {"metric": "resnet%d_serving_throughput_img_s_b%d"
+                           % (depth, batch),
+                 "value": round(float(np.median(samples)), 2),
+                 "samples": [round(s, 1) for s in samples],
+                 "unit": "img/s", "dtype": "bfloat16",
+                 "device_ms_per_batch": round(t_chain_batch, 2),
+                 "dispatch_ms_per_call": round(
+                     max(t_single - t_chain_batch, 0.0), 2),
+                 "stage_mb_s": round(
+                     stacked_np['img'].nbytes / 1e6 / t_upload, 1),
+                 "chain": thr_chain_b}
         else:
-            # chain calls through a data dependency inside ONE jit (each
-            # feed depends on the previous logits) and sync once: on the
-            # tunneled bench box per-call dispatch costs an RTT, which
-            # would measure the network, not the chip
-            from jax import export as jax_export
-            with open(path, 'rb') as f:
-                exported = jax_export.deserialize(f.read())
-            key = jax.random.PRNGKey(0)
-
-            def chain(x0):
-                def body(_, x):
-                    out = exported.call({'img': x}, key)[0]
-                    return x + 0.0 * out.astype(jnp.float32).sum()
-                return jax.lax.fori_loop(0, thr_chain, body, x0)
-
-            chain_j = jax.jit(chain)
-            xj = jax.device_put(x, place.jax_device())
-            np.asarray(chain_j(xj))  # compile
+            # pipelined async dispatch: K independent predict_async
+            # calls in flight, one sync at the end — no stacking, no
+            # special chain program, just not blocking per call
+            futures = [server.predict_async({'img': x})
+                       for _ in range(thr_chain_b)]
+            [np.asarray(o) for o in futures[-1]]
             samples = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                np.asarray(chain_j(xj))
-                samples.append(batch * thr_chain /
+                futures = [server.predict_async({'img': x})
+                           for _ in range(thr_chain_b)]
+                for outs in futures:
+                    for o in outs:
+                        np.asarray(o)
+                samples.append(batch * thr_chain_b /
                                (time.perf_counter() - t0))
-            r = {"metric": "resnet%d_serving_throughput_img_s_b%d"
+            r = {"metric": "resnet%d_serving_pipelined_img_s_b%d"
                            % (depth, batch),
                  "value": round(float(np.median(samples)), 2),
                  "samples": [round(s, 1) for s in samples],

@@ -13,8 +13,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def maybe_force_cpu():
+    """Honour a CPU-smoke request via the config API: the bench box's
+    sitecustomize re-registers the TPU tunnel plugin and clears
+    JAX_PLATFORMS after interpreter start, so the env var alone silently
+    lands the 'CPU' run on the (single, shared) TPU.  Call before any
+    other jax use."""
+    import jax
+    if os.environ.get('PADDLE_TPU_BENCH_CPU') or \
+            os.environ.get('JAX_PLATFORMS', '').lower() == 'cpu':
+        jax.config.update('jax_platforms', 'cpu')
+
+
 def on_tpu():
     import jax
+    maybe_force_cpu()
     return any(d.platform == 'tpu' for d in jax.devices())
 
 

@@ -101,10 +101,12 @@ class SimpleDistributeTranspiler(DistributeTranspiler):
     """Reference SimpleDistributeTranspiler parity: round-robin WHOLE-var
     placement (reference distribute_transpiler_simple round_robin() — no
     intra-var splitting).  Each mesh member owns entire parameters; the
-    ownership map drives per-member checkpointing (io.save_checkpoint
-    sharding) and introspection.  Execution keeps tensors replicated —
-    whole-var ownership has no intra-tensor split for GSPMD to exploit,
-    so the plan is PartitionSpec() for every var."""
+    ownership map drives per-member checkpointing via
+    ``save_member_checkpoint`` (each member writes only the whole vars
+    it owns — io.py's merged manifests reassemble the full checkpoint)
+    and introspection.  Execution keeps tensors replicated — whole-var
+    ownership has no intra-tensor split for GSPMD to exploit, so the
+    plan is PartitionSpec() for every var."""
 
     def transpile(self, trainer_id=0, program=None, pservers=None,
                   trainers=1, split_method=None, mesh=None,
@@ -132,3 +134,44 @@ class SimpleDistributeTranspiler(DistributeTranspiler):
         if endpoint is None:
             return dict(placement)
         return {n: m for n, m in placement.items() if m == int(endpoint)}
+
+    def member_vars(self, member, main_program=None):
+        """The persistable vars member ``member`` checkpoints: the whole
+        params the round-robin map assigns it, plus every derived
+        persistable riding a param's name (optimizer accumulators are
+        named ``<param>_<acc>_<uid>``) — the reference pserver keeps a
+        param's optimizer state next to the param.  Unattributable
+        persistables (global counters, LR schedules) go to member 0."""
+        placement = getattr(self, '_placement', {})
+        prog = main_program or self.program
+        member = int(member)
+        out = []
+        for v in prog.list_vars():
+            if not v.persistable:
+                continue
+            owner = placement.get(v.name)
+            if owner is None:
+                # longest param-name prefix wins ('w' vs 'w_tail')
+                best = max((p for p in placement
+                            if v.name.startswith(p + '_')),
+                           key=len, default=None)
+                owner = placement[best] if best is not None else 0
+            if owner == member:
+                out.append(v)
+        return out
+
+    def save_member_checkpoint(self, executor, dirname, member,
+                               main_program=None, step=None):
+        """Member ``member`` writes only the vars it owns.  Run on every
+        member (any order, any process): io's per-process manifests and
+        save-generation merge make the union the complete checkpoint,
+        loadable with plain io.load_checkpoint."""
+        from .. import io
+        prog = main_program or self.program
+        io.save_vars(executor, dirname, prog,
+                     vars=self.member_vars(member, prog),
+                     generation=None if step is None else int(step) + 1)
+        if step is not None and int(member) == 0:
+            import os
+            with open(os.path.join(dirname, 'STEP'), 'w') as f:
+                f.write(str(int(step)))

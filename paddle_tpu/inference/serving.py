@@ -88,12 +88,64 @@ def load_exported(path):
 
 
 class InferenceServer(object):
-    """Minimal in-process serving wrapper over an exported artifact
-    (capi-equivalent surface: load once, predict many)."""
+    """In-process serving wrapper over an exported artifact
+    (capi-equivalent surface: load once, predict many).
+
+    Three call shapes, by dispatch cost (the run_steps lesson applied to
+    serving — over a network-attached accelerator each synchronous call
+    pays a host round trip):
+
+    - ``predict(feed)``: one request, full sync — simplest, RTT-bound.
+    - ``predict_async(feed)``: dispatches and returns device futures
+      immediately (jax async dispatch); sync with np.asarray when the
+      answer is needed.  Back-to-back calls pipeline — the next request
+      uploads/dispatches while the device still runs the previous one.
+    - ``predict_many(feeds)``: K requests as ONE device program — feeds
+      stack on a leading axis and a lax.scan runs the forward K times,
+      syncing once.  Amortizes dispatch to RTT/K; the jitted chain is
+      cached per (K, shapes)."""
 
     def __init__(self, path):
-        self._fn = load_exported(path)
+        with open(path, 'rb') as f:
+            self._exported = jax_export.deserialize(f.read())
+        self._call = jax.jit(self._exported.call)
+        self._key = jax.random.PRNGKey(0)
+        exported, key = self._exported, self._key
+
+        def run_chain(stacked):
+            def body(carry, xs):
+                return carry, exported.call(xs, key)
+            _, ys = jax.lax.scan(body, 0, stacked)
+            return ys
+
+        # one jit wrapper: jit itself specializes (and caches) per
+        # stacked shape/dtype signature, K included as the leading dim
+        self._run_chain = jax.jit(run_chain)
 
     def predict(self, feed):
-        outs = self._fn({k: np.asarray(v) for k, v in feed.items()})
-        return [np.asarray(o) for o in outs]
+        return [np.asarray(o) for o in self.predict_async(feed)]
+
+    def predict_async(self, feed):
+        """Dispatch one request without waiting; returns jax.Arrays."""
+        return list(self._call(
+            {k: np.asarray(v) for k, v in feed.items()}, self._key))
+
+    def predict_many(self, feeds):
+        """K feed dicts -> list of K output lists, one device dispatch."""
+        if not feeds:
+            return []
+        k = len(feeds)
+        stacked = {name: np.stack([np.asarray(f[name]) for f in feeds])
+                   for name in feeds[0]}
+        ys = [np.asarray(y) for y in self.predict_stacked(stacked, k)]
+        return [[y[i] for y in ys] for i in range(k)]
+
+    def predict_stacked(self, stacked, k=None):
+        """K requests pre-stacked on a leading axis ({name: [K, ...]});
+        returns [K, ...] jax.Arrays, no host sync.  Accepts
+        device-resident inputs untouched — a streaming server keeps a
+        staging buffer on device (jax.device_put the next stack while
+        the current one runs) so the host->device upload overlaps
+        compute instead of serializing with it.  ``k`` is implied by
+        the leading axis; the jit specializes per stacked shapes."""
+        return self._run_chain(stacked)

@@ -120,7 +120,7 @@ def _save_sharded(dirname, name, value):
     return records
 
 
-def _merge_var_record(old, new, name):
+def _merge_var_record(old, new):
     """Merge two manifest records for the same var.
 
     Records carry a save-generation counter (``gen``): differing gens
@@ -178,9 +178,23 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         # (all manifests — a process's own history alone diverges when
         # the host count changes between runs, and a stale higher-gen
         # sibling record would then shadow this save at load).  Hosts of
-        # one synchronized save read the same history and agree; callers
-        # with a natural logical clock (save_checkpoint's step) pass it
-        # as `generation`, which is immune even to save-vs-save races.
+        # one synchronized save read the same history and agree.  On
+        # multi-host, UNsynchronized saves can race this read (a host
+        # arriving after a sibling finished seeds gen+1 and the load
+        # fails LOUDLY as incomplete): pass `generation` — or use
+        # save_checkpoint(step=...), whose step is the race-free
+        # logical clock.
+        try:
+            import jax
+            if jax.process_count() > 1:
+                import warnings
+                warnings.warn(
+                    "multi-host save_vars without generation=: hosts "
+                    "must save in lockstep or the manifest merge may "
+                    "reject the checkpoint; prefer "
+                    "save_checkpoint(step=...)")
+        except Exception:
+            pass
         merged = _read_manifest(dirname)
         recs = merged['vars'].values() if merged else []
         generation = 1 + max([r.get('gen', 0) for r in recs] + [0])
@@ -279,7 +293,7 @@ def _read_manifest(dirname, own_only=False):
             continue
         for name, rec in m.get('vars', {}).items():
             merged['vars'][name] = _merge_var_record(
-                merged['vars'].get(name), rec, name)
+                merged['vars'].get(name), rec)
     return merged
 
 

@@ -399,7 +399,7 @@ def fused_linear_softmax_ce(input, label, size, num_flatten_dims=1,
     helper.append_op(
         type='fused_linear_softmax_ce', inputs=inputs,
         outputs={'Loss': [loss]},
-        attrs={'chunk': int(chunk), 'mode': mode},
+        attrs={'chunk': int(chunk), 'mode': mode, 'flatten': flatten},
         infer_shape=False)
     loss.shape = tuple(input_shape[:flatten]) + (1,)
     return loss

@@ -82,17 +82,24 @@ def glu(input, dim=-1):
 
 def scaled_dot_product_attention(queries, keys, values,
                                  num_heads=1, dropout_rate=0.0,
-                                 use_flash=False, causal=False,
+                                 use_flash=None, causal=False,
                                  pallas_interpret=False):
     """Multi-head scaled dot-product attention (fluid/nets.py parity).
     Inputs are [batch, seq, d]; runs as MXU batched matmuls.
 
-    use_flash=True routes through the fused Pallas online-softmax kernel
-    (ops/pallas/flash_attention.py) — no [Tq, Tk] score matrix in HBM;
-    dropout_rate must be 0 on that path."""
+    use_flash routes through the fused Pallas online-softmax kernel
+    (ops/pallas/flash_attention.py) — no [Tq, Tk] score matrix in HBM.
+    The default (None) is TPU-first: flash whenever the config qualifies
+    (no attention-probability dropout — the one thing the kernel doesn't
+    implement); the op itself computes the same math densely when the
+    executor's place is not a TPU, so a program built with the flash op
+    stays portable.  Pass False to force the composed matmul+softmax
+    form."""
     if num_heads < 1:
         raise ValueError("num_heads must be >= 1")
     head_dim = queries.shape[-1] // num_heads
+    if use_flash is None:
+        use_flash = dropout_rate == 0.0
 
     if use_flash:
         if dropout_rate:

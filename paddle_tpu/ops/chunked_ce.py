@@ -193,8 +193,11 @@ def _fused_linear_softmax_ce(ctx, ins, attrs):
     label = first(ins, 'Label')
     chunk = int(attrs.get('chunk', _DEF_CHUNK))
     mode = attrs.get('mode', 'auto')
-    lead = x.shape[:-1]
-    d = x.shape[-1]
+    # feature dims start at `flatten` (the layer's num_flatten_dims
+    # resolution) — everything before is batch-like
+    flatten = int(attrs.get('flatten', x.ndim - 1))
+    lead = x.shape[:flatten]
+    d = int(np.prod(x.shape[flatten:]))
     v = w.shape[1]
     if b is None:
         b = jnp.zeros((v,), jnp.float32)

@@ -59,16 +59,34 @@ def _unreverse_and_mask(seqs, rev_idx, lengths, t):
     return out
 
 
-def _rnn_vmem_budget():
-    """VMEM bytes the BPTT kernel may claim.  TPU cores have ~16MB VMEM
-    across generations; default to 12MB (25% margin for Mosaic's own
-    temporaries).  PADDLE_TPU_RNN_VMEM_BUDGET_MB overrides for parts
-    where the margin is wrong in either direction."""
-    mb = os.environ.get('PADDLE_TPU_RNN_VMEM_BUDGET_MB')
+def _device_vmem_bytes():
+    """Per-core VMEM of the attached accelerator, from device_kind:
+    16 MB for TPU v2–v5 families, 32 MB starting with the v6
+    generation (Trillium), 16 MB when the generation is unparseable."""
+    import re
     try:
-        return int(float(mb) * 1024 * 1024) if mb else 12 * 1024 * 1024
-    except ValueError:
-        return 12 * 1024 * 1024
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 16 * 1024 * 1024
+    m = re.search(r'v(\d+)', kind)
+    if m and int(m.group(1)) >= 6:
+        return 32 * 1024 * 1024
+    return 16 * 1024 * 1024
+
+
+def _rnn_vmem_budget():
+    """VMEM bytes the BPTT kernel may claim: 75% of the device's VMEM
+    (the rest is margin for Mosaic's own temporaries), derived from the
+    attached device generation rather than hardcoded.
+    PADDLE_TPU_RNN_VMEM_BUDGET_MB overrides for parts where the margin
+    is wrong in either direction."""
+    mb = os.environ.get('PADDLE_TPU_RNN_VMEM_BUDGET_MB')
+    if mb:
+        try:
+            return int(float(mb) * 1024 * 1024)
+        except ValueError:
+            pass
+    return int(_device_vmem_bytes() * 0.75)
 
 
 def _pallas_rnn_fits_vmem(batch, hidden, gate_width):

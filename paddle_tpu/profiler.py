@@ -12,17 +12,22 @@ import jax
 
 __all__ = ['profiler', 'cuda_profiler', 'CudaProfiler',
            'reset_profiler', 'RecordEvent',
-           'start_profiler', 'stop_profiler']
+           'start_profiler', 'stop_profiler', 'profile_table']
 
 _events = []
+_last_log_dir = None
 
 
 @contextlib.contextmanager
 def profiler(state='All', sorted_key=None, log_dir='/tmp/paddle_tpu_prof'):
-    """Trace the enclosed region with the XLA profiler."""
+    """Trace the enclosed region with the XLA profiler.  On exit, a
+    non-None ``sorted_key`` prints the per-fusion table (the reference
+    profiler.cc ParseEvents table)."""
+    global _last_log_dir
     os.makedirs(log_dir, exist_ok=True)
     try:
         jax.profiler.start_trace(log_dir)
+        _last_log_dir = log_dir
         started = True
     except RuntimeError as e:  # e.g. a trace is already running
         import warnings
@@ -34,6 +39,9 @@ def profiler(state='All', sorted_key=None, log_dir='/tmp/paddle_tpu_prof'):
     finally:
         if started:
             jax.profiler.stop_trace()
+            if sorted_key is not None:
+                print(profile_table(sorted_key=sorted_key,
+                                    log_dir=log_dir))
         _events.append(('profile_region', time.time() - t0))
 
 
@@ -44,12 +52,90 @@ CudaProfiler = profiler
 
 
 def start_profiler(state='All', log_dir='/tmp/paddle_tpu_prof'):
+    global _last_log_dir
     os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
+    _last_log_dir = log_dir
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
+    """Stop the trace; with ``sorted_key`` print (and optionally write
+    to ``profile_path``) the per-fusion table — the TPU counterpart of
+    the reference's ParseEvents output (platform/profiler.cc:211):
+    one row per device op/fusion with calls / total / min / max / ave,
+    sorted by ``sorted_key`` in {'calls','total','max','min','ave'}."""
     jax.profiler.stop_trace()
+    if sorted_key is None:
+        return None
+    table = profile_table(sorted_key=sorted_key)
+    print(table)
+    if profile_path:
+        with open(profile_path, 'w') as f:
+            f.write(table)
+    return table
+
+
+def _device_event_rows(log_dir):
+    """Aggregate the newest trace's complete events into
+    {name: [calls, total_us, min_us, max_us]} — device (TPU) events when
+    present, host-track events otherwise (CPU-backend runs)."""
+    import glob
+    import gzip
+    import json
+    files = sorted(glob.glob(os.path.join(glob.escape(log_dir),
+                                          '**', '*.trace.json.gz'),
+                             recursive=True),
+                   key=os.path.getmtime)
+    if not files:
+        return {}
+    with gzip.open(files[-1], 'rt') as f:
+        trace = json.load(f)
+    events = trace.get('traceEvents', [])
+    dev_pids = {e['pid'] for e in events
+                if e.get('ph') == 'M' and e.get('name') == 'process_name'
+                and 'TPU' in str(e.get('args', {}).get('name', ''))}
+    rows = {}
+    use_dev = bool(dev_pids)
+    for e in events:
+        if e.get('ph') != 'X' or 'dur' not in e:
+            continue
+        if use_dev and e.get('pid') not in dev_pids:
+            continue
+        name = e.get('name', '')
+        if not name:
+            continue
+        d = float(e['dur'])
+        row = rows.get(name)
+        if row is None:
+            rows[name] = [1, d, d, d]
+        else:
+            row[0] += 1
+            row[1] += d
+            row[2] = min(row[2], d)
+            row[3] = max(row[3], d)
+    return rows
+
+
+def profile_table(sorted_key='total', log_dir=None):
+    """Render the per-op table from the latest trace in ``log_dir``
+    (default: the directory the last start_profiler used)."""
+    key = (sorted_key or 'total').lower()
+    order = {'calls': lambda r: r[1][0], 'total': lambda r: r[1][1],
+             'min': lambda r: r[1][2], 'max': lambda r: r[1][3],
+             'ave': lambda r: r[1][1] / r[1][0]}
+    if key not in order:
+        raise ValueError("sorted_key must be one of %s, got %r"
+                         % (sorted(order), sorted_key))
+    rows = _device_event_rows(log_dir or _last_log_dir or
+                              '/tmp/paddle_tpu_prof')
+    lines = ["%-52s %8s %12s %12s %12s %12s" %
+             ("Event", "Calls", "Total(us)", "Min(us)", "Max(us)",
+              "Ave(us)")]
+    for name, (calls, total, mn, mx) in sorted(
+            rows.items(), key=order[key], reverse=True):
+        lines.append("%-52s %8d %12.1f %12.1f %12.1f %12.1f" %
+                     (name[:52], calls, total, mn, mx, total / calls))
+    return "\n".join(lines)
 
 
 def reset_profiler():

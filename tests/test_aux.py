@@ -176,3 +176,39 @@ def test_ploter_savefig(tmp_path, monkeypatch):
     out = tmp_path / 'curve.png'
     p.plot(str(out))
     assert out.exists() and out.stat().st_size > 0
+
+
+def test_profiler_sorted_table(tmp_path):
+    """VERDICT r3 #7: stop_profiler(sorted_key=...) renders the per-op
+    table (calls/total/min/max/ave) from the captured XLA trace, sorted
+    by the requested key — profiler.cc ParseEvents parity."""
+    from paddle_tpu import profiler
+    main, startup, img, label, predict, cost = _mnist_like_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.randn(4, 16).astype('float32'),
+            'label': rng.randint(0, 4, (4, 1)).astype('int64')}
+    d = str(tmp_path / 'prof')
+    profiler.start_profiler(log_dir=d)
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[cost])
+    table = profiler.stop_profiler(sorted_key='total',
+                                   profile_path=str(tmp_path / 'p.txt'))
+    assert table is not None
+    lines = table.splitlines()
+    assert lines[0].split()[:2] == ['Event', 'Calls']
+    assert len(lines) > 1, "no trace rows parsed"
+    totals = [float(l.split()[-4]) for l in lines[1:]]
+    assert totals == sorted(totals, reverse=True)
+    assert (tmp_path / 'p.txt').exists()
+
+    # ave ordering differs from total ordering in general; just assert
+    # it renders and is sorted by the requested key
+    t2 = profiler.profile_table(sorted_key='ave', log_dir=d)
+    aves = [float(l.split()[-1]) for l in t2.splitlines()[1:]]
+    assert aves == sorted(aves, reverse=True)
+
+    import pytest
+    with pytest.raises(ValueError, match='sorted_key'):
+        profiler.profile_table(sorted_key='bogus', log_dir=d)

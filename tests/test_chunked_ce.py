@@ -88,6 +88,49 @@ def test_fused_layer_trains_like_dense_layer():
     assert runs['chunked'][-1] < runs['chunked'][0]  # it actually learns
 
 
+def test_fused_layer_rank3_num_flatten_dims():
+    """Code-review r4: a rank-3 non-lod input with num_flatten_dims=1
+    flattens trailing dims into the feature axis (fc parity) — W is
+    [d1*d2, V] and the loss is [B, 1]."""
+    from paddle_tpu.core.program import reset_unique_name_guard
+    from paddle_tpu.param_attr import ParamAttr
+
+    def build(fused):
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 2
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[3, 8],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+                if fused:
+                    cost = fluid.layers.fused_linear_softmax_ce(
+                        input=x, label=y, size=30, chunk=8,
+                        mode='chunked', param_attr=ParamAttr(name='o_w'),
+                        bias_attr=ParamAttr(name='o_b'))
+                else:
+                    logits = fluid.layers.fc(
+                        input=x, size=30,
+                        param_attr=ParamAttr(name='o_w'),
+                        bias_attr=ParamAttr(name='o_b'))
+                    cost = fluid.layers.softmax_with_cross_entropy(
+                        logits=logits, label=y)
+                loss = fluid.layers.mean(x=cost)
+        return main, startup, loss
+
+    rng = np.random.RandomState(8)
+    feed = {'x': rng.randn(6, 3, 8).astype('float32'),
+            'y': rng.randint(0, 30, (6, 1)).astype('int64')}
+    vals = {}
+    for fused in (False, True):
+        main, startup, loss = build(fused)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals[fused] = float(np.ravel(exe.run(main, feed=feed,
+                                             fetch_list=[loss])[0])[0])
+    np.testing.assert_allclose(vals[True], vals[False], rtol=1e-5)
+
+
 def test_fused_layer_bf16_matches_dense_bf16():
     """bf16 activations with fp32 master head: fused loss stays close to
     the dense bf16 composition (same matmul precision class)."""

@@ -217,3 +217,70 @@ def test_book_models_on_mesh(model):
                                     fetch_list=[loss])[0])[0])
               for _ in range(12)]
     assert np.mean(losses[-2:]) < np.mean(losses[:2])
+
+
+def test_simple_transpiler_member_checkpointing(tmp_path):
+    """VERDICT r3 #5: SimpleDistributeTranspiler's round-robin placement
+    map drives per-member checkpointing — each member writes only the
+    whole vars (params + their optimizer accumulators) it owns, and the
+    union of member saves loads as a complete checkpoint."""
+    import os
+
+    from paddle_tpu import io
+    from paddle_tpu.core.program import reset_unique_name_guard
+    from paddle_tpu.distributed.transpiler import (
+        SimpleDistributeTranspiler)
+
+    with reset_unique_name_guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 12
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            h = fluid.layers.fc(input=x, size=8, act='relu')
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(8, 6).astype('float32')
+    exe.run(main, feed={'x': xb, 'y': xb[:, :1]}, fetch_list=[loss])
+
+    t = SimpleDistributeTranspiler()
+    t.transpile(program=main, trainers=2)
+    placement = t.get_pserver_program()
+    assert sorted(set(placement.values())) == [0, 1]  # both members own
+
+    scope = fluid.global_scope()
+    persist = {v.name: np.asarray(scope.find_var(v.name))
+               for v in main.list_vars()
+               if v.persistable and scope.find_var(v.name) is not None}
+
+    # ownership partitions the persistables: disjoint and complete
+    own0 = {v.name for v in t.member_vars(0, main)}
+    own1 = {v.name for v in t.member_vars(1, main)}
+    assert own0 & own1 == set()
+    assert own0 | own1 == set(persist)
+    # accumulators follow their param's owner
+    for pname, m in placement.items():
+        owner = own0 if m == 0 else own1
+        accs = [n for n in persist if n.startswith(pname + '_')]
+        assert accs and all(a in owner for a in accs)
+
+    d = str(tmp_path / 'member_ckpt')
+    t.save_member_checkpoint(exe, d, member=0, step=1)
+    saved0 = set(io._read_manifest(d)['vars'])
+    assert saved0 == own0, "member 0 wrote exactly its owned vars"
+    t.save_member_checkpoint(exe, d, member=1, step=1)
+    assert set(io._read_manifest(d)['vars']) == own0 | own1
+
+    for n, v in persist.items():
+        scope.set(n, np.zeros_like(v))
+    step = io.load_checkpoint(exe, d, main)
+    assert step == 1
+    for n, v in persist.items():
+        np.testing.assert_array_equal(np.asarray(scope.find_var(n)), v,
+                                      err_msg=n)

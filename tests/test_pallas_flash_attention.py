@@ -74,7 +74,7 @@ def test_nets_attention_flash_matches_matmul_path():
         k = fluid.layers.data(name='k', shape=[t, dm], dtype='float32')
         v = fluid.layers.data(name='v', shape=[t, dm], dtype='float32')
         dense = fluid.nets.scaled_dot_product_attention(
-            q, k, v, num_heads=heads)
+            q, k, v, num_heads=heads, use_flash=False)
         flash = fluid.nets.scaled_dot_product_attention(
             q, k, v, num_heads=heads, use_flash=True,
             pallas_interpret=True)  # exercise the KERNEL path on CPU CI
@@ -83,6 +83,50 @@ def test_nets_attention_flash_matches_matmul_path():
     feed = {n: rng.randn(b, t, dm).astype('float32') for n in 'qkv'}
     o1, o2 = exe.run(main, feed=feed, fetch_list=[dense, flash])
     np.testing.assert_allclose(np.asarray(o2), np.asarray(o1),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_nets_attention_defaults_to_flash():
+    """VERDICT r3 #6: the TPU-first kernel is the layer DEFAULT where
+    the config qualifies (no attention dropout); dropout falls back to
+    the composed matmul+softmax path; numerics match the forced-dense
+    build either way (off-TPU the op computes dense math itself)."""
+    import paddle_tpu as fluid
+
+    b, t, dm, heads = 2, 32, 16, 2
+
+    def build(**kwargs):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = fluid.layers.data(name='q', shape=[t, dm],
+                                  dtype='float32')
+            k = fluid.layers.data(name='k', shape=[t, dm],
+                                  dtype='float32')
+            v = fluid.layers.data(name='v', shape=[t, dm],
+                                  dtype='float32')
+            o = fluid.nets.scaled_dot_product_attention(
+                q, k, v, num_heads=heads, **kwargs)
+        return main, startup, o
+
+    main, startup, o = build()
+    assert any(op.type == 'flash_attention'
+               for op in main.global_block().ops), \
+        "default must ride the flash op"
+    md, sd, od = build(use_flash=False)
+    assert not any(op.type == 'flash_attention'
+                   for op in md.global_block().ops)
+    mdrop, _, _ = build(dropout_rate=0.3)
+    assert not any(op.type == 'flash_attention'
+                   for op in mdrop.global_block().ops), \
+        "dropout configs fall back to the composed path"
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {n: rng.randn(b, t, dm).astype('float32') for n in 'qkv'}
+    got = exe.run(main, feed=feed, fetch_list=[o])[0]
+    exe.run(sd)
+    want = exe.run(md, feed=feed, fetch_list=[od])[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
 
 
@@ -162,3 +206,42 @@ def test_nets_attention_dense_fallback_matches_matmul_path():
     o1, o2 = exe.run(main, feed=feed, fetch_list=[dense, flash])
     np.testing.assert_allclose(np.asarray(o2), np.asarray(o1),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bwd_env_gate_resolves_at_call_time(monkeypatch):
+    """r2 advisor: the backward-mode env gates are read when
+    flash_attention() is CALLED (and ride the vjp cache key as a
+    nondiff arg), so toggling them mid-process changes the next trace
+    instead of silently hitting a stale cached closure."""
+    import importlib
+    # the package re-exports the function under the module's name, so a
+    # plain import binds the function; fetch the module itself
+    fa = importlib.import_module('paddle_tpu.ops.pallas.flash_attention')
+    monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_PALLAS', raising=False)
+    monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_SCAN', raising=False)
+    assert fa._bwd_mode_from_env(True) == 'scan'     # interpret => scan
+    assert fa._bwd_mode_from_env(False) == 'pallas'  # tpu default
+    monkeypatch.setenv('PADDLE_TPU_FLASH_BWD_SCAN', '1')
+    assert fa._bwd_mode_from_env(False) == 'scan'
+    monkeypatch.setenv('PADDLE_TPU_FLASH_BWD_PALLAS', '1')
+    assert fa._bwd_mode_from_env(True) == 'pallas'
+
+
+def test_rnn_vmem_budget_derives_from_device(monkeypatch):
+    """r2 advisor: the BPTT VMEM budget tracks the device generation
+    (16 MB through v5, 32 MB from v6) instead of a hardcoded 12 MB;
+    the env override still wins."""
+    from paddle_tpu.ops import rnn
+
+    class FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    monkeypatch.delenv('PADDLE_TPU_RNN_VMEM_BUDGET_MB', raising=False)
+    monkeypatch.setattr(rnn.jax, 'devices',
+                        lambda: [FakeDev('TPU v5 lite')])
+    assert rnn._rnn_vmem_budget() == int(16 * 1024 * 1024 * 0.75)
+    monkeypatch.setattr(rnn.jax, 'devices', lambda: [FakeDev('TPU v6e')])
+    assert rnn._rnn_vmem_budget() == int(32 * 1024 * 1024 * 0.75)
+    monkeypatch.setenv('PADDLE_TPU_RNN_VMEM_BUDGET_MB', '5')
+    assert rnn._rnn_vmem_budget() == 5 * 1024 * 1024
