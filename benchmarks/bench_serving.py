@@ -111,8 +111,13 @@ def main():
             # sample, so the breakdown describes the same run as the
             # reported value.
             t_chain_batch = float(np.median(totals)) / thr_chain_b * 1e3
+            # single call on a DEVICE-resident batch: its wall is
+            # RTT + device, so the difference below is pure per-call
+            # dispatch overhead, not upload (stage_mb_s carries that)
+            xd = jax.device_put(x, place.jax_device())
+            np.asarray(server.predict({'img': xd})[0])  # warm path
             t0 = time.perf_counter()
-            np.asarray(server.predict({'img': x})[0])
+            np.asarray(server.predict({'img': xd})[0])
             t_single = (time.perf_counter() - t0) * 1e3
             r = {"metric": "resnet%d_serving_throughput_img_s_b%d"
                            % (depth, batch),

@@ -1,0 +1,70 @@
+"""Peak-HBM and remat matrix for the ResNet-50 train step (PERF.md's
+memory table; VERDICT r3 #3).
+
+For each (batch, remat level) prints one JSON line with the compiled
+step's memory_analysis: temp / argument / output / aliased bytes and the
+estimated peak.  Reference analogue: the measurable effect of
+python/paddle/v2/fluid/memory_optimization_transpiler.py, realized here
+as jax.checkpoint remat levels (transpiler/memory_optimize.py).
+
+Usage: python memory_report.py [batches...]   (default 64 128 256)
+"""
+import importlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from common import on_tpu  # noqa: E402
+
+memory_optimize = importlib.import_module(
+    'paddle_tpu.transpiler.memory_optimize')
+
+
+def report(batch, level, hw=224, depth=50, classes=1000):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img, label, prediction, avg_cost, acc = resnet.build_imagenet(
+            depth=depth, num_classes=classes, image_shape=(hw, hw, 3),
+            dtype='bfloat16', layout='NHWC')
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(avg_cost)
+    if level is not None:
+        memory_optimize.memory_optimize(main_prog, level=level)
+    place = fluid.TPUPlace(0) if on_tpu() else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    feed = {'img': rng.normal(size=(batch, hw, hw, 3)).astype(np.float32),
+            'label': rng.integers(0, classes,
+                                  (batch, 1)).astype(np.int32)}
+    fn, args = exe.compile(main_prog, feed=feed, fetch_list=[avg_cost])
+    ma = fn.lower(*args).compile().memory_analysis()
+    peak = (ma.temp_size_in_bytes + ma.argument_size_in_bytes +
+            ma.output_size_in_bytes - ma.alias_size_in_bytes)
+    print(json.dumps({
+        "metric": "resnet%d_train_peak_hbm_gb" % depth,
+        "batch": batch, "remat": level,
+        "value": round(peak / 2**30, 3), "unit": "GB",
+        "temp_gb": round(ma.temp_size_in_bytes / 2**30, 3),
+        "args_gb": round(ma.argument_size_in_bytes / 2**30, 3),
+    }), flush=True)
+
+
+def main():
+    batches = [int(b) for b in sys.argv[1:]] or \
+        ([64, 128, 256] if on_tpu() else [8])
+    hw, depth, classes = (224, 50, 1000) if on_tpu() else (64, 18, 100)
+    for batch in batches:
+        for level in (None, 'dots', 'full'):
+            report(batch, level, hw=hw, depth=depth, classes=classes)
+
+
+if __name__ == '__main__':
+    main()

@@ -170,8 +170,6 @@ class SimpleDistributeTranspiler(DistributeTranspiler):
         prog = main_program or self.program
         io.save_vars(executor, dirname, prog,
                      vars=self.member_vars(member, prog),
-                     generation=None if step is None else int(step) + 1)
+                     generation=io.step_generation(step))
         if step is not None and int(member) == 0:
-            import os
-            with open(os.path.join(dirname, 'STEP'), 'w') as f:
-                f.write(str(int(step)))
+            io.write_step_file(dirname, step)

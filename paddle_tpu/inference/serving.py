@@ -126,9 +126,12 @@ class InferenceServer(object):
         return [np.asarray(o) for o in self.predict_async(feed)]
 
     def predict_async(self, feed):
-        """Dispatch one request without waiting; returns jax.Arrays."""
+        """Dispatch one request without waiting; returns jax.Arrays.
+        Device-resident feed values pass through (np.asarray would drag
+        them back to host and re-upload)."""
         return list(self._call(
-            {k: np.asarray(v) for k, v in feed.items()}, self._key))
+            {k: (v if isinstance(v, jax.Array) else np.asarray(v))
+             for k, v in feed.items()}, self._key))
 
     def predict_many(self, feeds):
         """K feed dicts -> list of K output lists, one device dispatch."""
@@ -147,5 +150,11 @@ class InferenceServer(object):
         staging buffer on device (jax.device_put the next stack while
         the current one runs) so the host->device upload overlaps
         compute instead of serializing with it.  ``k`` is implied by
-        the leading axis; the jit specializes per stacked shapes."""
+        the leading axis; when passed it is validated against it."""
+        if k is not None and stacked:
+            lead = {n: np.shape(v)[0] for n, v in stacked.items()}
+            if any(l != int(k) for l in lead.values()):
+                raise ValueError(
+                    "predict_stacked k=%d disagrees with the stacked "
+                    "leading axes %s" % (k, lead))
         return self._run_chain(stacked)

@@ -532,16 +532,27 @@ def get_parameter_value_by_name(name, executor=None, program=None):
 
 
 # -- checkpoint/resume (SURVEY.md A2) ------------------------------------
+def step_generation(step):
+    """The save-generation logical clock a training step maps to — the
+    ONE place the step->generation protocol lives (save_checkpoint and
+    the per-member distributed saves must agree)."""
+    return None if step is None else int(step) + 1
+
+
+def write_step_file(dirname, step):
+    with open(os.path.join(dirname, 'STEP'), 'w') as f:
+        f.write(str(int(step)))
+
+
 def save_checkpoint(executor, dirname, main_program=None, step=None):
     """Full training state: every persistable (params + optimizer moments +
     bn stats + counters).  ``step`` doubles as the save-generation logical
     clock: every host of a synchronized save passes the same step, so the
     manifest merge is race-free even across host-count changes."""
     save_persistables(executor, dirname, main_program,
-                      generation=None if step is None else int(step) + 1)
+                      generation=step_generation(step))
     if step is not None:
-        with open(os.path.join(dirname, 'STEP'), 'w') as f:
-            f.write(str(int(step)))
+        write_step_file(dirname, step)
 
 
 def load_checkpoint(executor, dirname, main_program=None):
