@@ -1,5 +1,6 @@
 from . import launch, transpiler
+from .pipeline import PipelineTranspiler
 from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
 
 __all__ = ['transpiler', 'launch', 'DistributeTranspiler',
-           'SimpleDistributeTranspiler']
+           'SimpleDistributeTranspiler', 'PipelineTranspiler']

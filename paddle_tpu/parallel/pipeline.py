@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ['pipeline_apply']
+__all__ = ['pipeline_apply', 'pipeline_train_1f1b']
 
 
 def pipeline_apply(stage_fn, params_shard, microbatches, axis_name,
@@ -73,3 +73,119 @@ def pipeline_apply(stage_fn, params_shard, microbatches, axis_name,
         outs0 = lax.pvary(outs0, (axis_name,))
     (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
     return outs
+
+
+def _varying(x, axis_name):
+    """Mark an array as per-member varying for shard_map scan carries."""
+    if hasattr(lax, 'pcast'):
+        return lax.pcast(x, (axis_name,), to='varying')
+    if hasattr(lax, 'pvary'):
+        return lax.pvary(x, (axis_name,))
+    return x
+
+
+def pipeline_train_1f1b(stage_fns, params_tuple, feeds, num_microbatches,
+                        axis_name, iface_shape, iface_dtype,
+                        loss_scale=None):
+    """One pipelined fwd+bwd train pass with 1F1B liveness, inside a
+    shard_map over ``axis_name`` (one mesh member per stage).
+
+    The GPipe form (pipeline_apply + autodiff through the scan) keeps
+    every tick's activations alive until its backward — O(M) stage
+    inputs per member.  Here the backward is part of the SAME scan:
+    at tick t, member r runs the forward of microbatch ``f = t - r``
+    and the backward of ``b = t - 2(S-1) + r`` (the classic
+    one-forward-one-backward schedule in closed form).  Stage inputs
+    wait in a ring buffer of 2S slots — a microbatch's input lives
+    exactly 2(S-1-r) ticks between its forward and its backward — so
+    activation liveness is bounded by the pipeline DEPTH, never by the
+    microbatch count.  Backward recomputes the stage body from the
+    saved input (jax.vjp per tick), cotangents ppermute upstream, and
+    per-stage param grads accumulate locally then psum across the axis.
+
+    :param stage_fns: list of S functions ``f(params_tuple, x, mb_feeds,
+        m) -> (y, loss_mb)`` — stage s reads its own entry of
+        ``params_tuple``; every non-last stage returns a
+        ``iface_shape`` activation and 0.0 loss; the LAST stage returns
+        a dummy activation and the per-microbatch loss.  Stage 0
+        ignores ``x`` and reads ``mb_feeds``.
+    :param params_tuple: tuple of per-stage param pytrees, replicated
+        across the axis (shard them over an orthogonal fsdp axis for
+        param memory; the pipeline axis owns ACTIVATION memory).
+    :param feeds: pytree of [M, mb, ...] arrays (replicated) — sliced
+        per microbatch inside the scan.
+    :param loss_scale: cotangent seed per microbatch (default 1/M —
+        the mean over microbatches).
+    :returns: (total_loss, grads_tuple) — both replicated across the
+        axis after psum.
+    """
+    S = len(stage_fns)
+    M = int(num_microbatches)
+    rank = lax.axis_index(axis_name)
+    seed = (1.0 / M) if loss_scale is None else loss_scale
+    ring_slots = 2 * S
+    total_ticks = M + 2 * (S - 1)
+
+    def fwd_all(params_tuple, x, mb_feeds, m, r):
+        return lax.switch(r, stage_fns, params_tuple, x, mb_feeds, m)
+
+    zero_grads = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(jnp.shape(a), jnp.float32), params_tuple)
+
+    def tick(carry, t):
+        fwd_buf, ct_buf, ring, dparams, loss_acc = carry
+        f = t - rank
+        fwd_on = (f >= 0) & (f < M)
+        b = t - 2 * (S - 1) + rank
+        bwd_on = (b >= 0) & (b < M)
+        fc = jnp.clip(f, 0, M - 1)
+        bc = jnp.clip(b, 0, M - 1)
+        mbf = jax.tree_util.tree_map(lambda a: a[fc], feeds)
+        mbb = jax.tree_util.tree_map(lambda a: a[bc], feeds)
+
+        # ---- forward of microbatch f ----
+        y, loss_mb = fwd_all(params_tuple, fwd_buf, mbf, fc, rank)
+        loss_acc = loss_acc + jnp.where(
+            fwd_on & (rank == S - 1), loss_mb * seed, 0.0)
+        ring = ring.at[fc % ring_slots].set(
+            jnp.where(fwd_on, fwd_buf, ring[fc % ring_slots]))
+
+        # ---- backward of microbatch b (recompute from the ring) ----
+        x_saved = ring[bc % ring_slots]
+        _, vjp = jax.vjp(
+            lambda P, x: fwd_all(P, x, mbb, bc, rank),
+            params_tuple, x_saved)
+        ct_y = jnp.where(rank == S - 1, jnp.zeros_like(ct_buf), ct_buf)
+        ct_loss = jnp.where(rank == S - 1, jnp.float32(seed), 0.0)
+        dP, dx = vjp((ct_y.astype(iface_dtype),
+                      ct_loss.astype(jnp.float32)))
+        on = bwd_on.astype(jnp.float32)
+        dparams = jax.tree_util.tree_map(
+            lambda acc, g: acc + on * g.astype(jnp.float32),
+            dparams, dP)
+
+        # ---- hand off: activations downstream, cotangents upstream ----
+        fwd_buf = lax.ppermute(y, axis_name,
+                               [(i, i + 1) for i in range(S - 1)])
+        dx_send = jnp.where(bwd_on, dx, jnp.zeros_like(dx))
+        ct_buf = lax.ppermute(dx_send, axis_name,
+                              [(i + 1, i) for i in range(S - 1)])
+        return (fwd_buf, ct_buf, ring, dparams, loss_acc), None
+
+    fwd0 = _varying(jnp.zeros(iface_shape, iface_dtype), axis_name)
+    ct0 = _varying(jnp.zeros(iface_shape, jnp.float32), axis_name)
+    ring0 = _varying(jnp.zeros((ring_slots,) + tuple(iface_shape),
+                               iface_dtype), axis_name)
+    dparams0 = jax.tree_util.tree_map(
+        lambda a: _varying(a, axis_name), zero_grads)
+    loss0 = _varying(jnp.float32(0.0), axis_name)
+
+    carry, _ = lax.scan(tick, (fwd0, ct0, ring0, dparams0, loss0),
+                        jnp.arange(total_ticks))
+    _fwd, _ct, _ring, dparams, loss_acc = carry
+    # loss lives on the last stage, each member holds only its own
+    # stage's grads — one psum each replicates both across the axis
+    loss = lax.psum(loss_acc, axis_name)
+    grads = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name), dparams)
+    return loss, grads
