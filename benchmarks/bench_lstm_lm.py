@@ -32,7 +32,7 @@ def main():
         return {'src': (mk(), ln), 'target': (mk(), ln)}
 
     run_bench('stacked_lstm_tokens_per_sec', batch * seq, build, feed,
-              steps=10 if on_tpu() else 3,
+              steps=50 if on_tpu() else 3,
               note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab),
               dtype='bfloat16')
 

@@ -78,6 +78,17 @@ class PipelineTranspiler(object):
         self.loss_name = ad.attrs['loss_name']
         self.param_names = list(ad.attrs['param_names'])
         self.grad_names = list(ad.attrs['grad_names'])
+        persistable = {v.name for v in program.list_vars()
+                       if v.persistable}
+        sparse = [n for n in self.param_names if n not in persistable]
+        if sparse:
+            # core/backward.py swaps is_sparse embedding params to their
+            # lookup-output vars; the pipeline's per-stage vjp has no
+            # sparse_grad_assemble path
+            raise ValueError(
+                "program uses sparse-grad (is_sparse=True) embeddings "
+                "%s — not supported by PipelineTranspiler; build the "
+                "embedding with is_sparse=False" % sparse)
         # everything after the autodiff op (grad clip, regularizers,
         # optimizer rules, LR schedules) replays on the pipeline grads
         self.post_ops = ops[ad_idxs[0] + 1:]
@@ -139,20 +150,29 @@ class PipelineTranspiler(object):
 
     # ------------------------------------------------------------------
     def _iface(self, scope):
-        """(flat width, dtype) of the padded stage-interface buffer."""
+        """(flat width, dtype) of the padded stage-interface buffer.
+        The buffer carries activations in the CUT VARS' OWN dtype (all
+        cuts must agree) so a bf16 program stays bf16 across stage
+        boundaries — numerically the same program as single-device."""
+        from ..core import datatypes
         block = self.program.global_block()
         widths, dtypes = [], []
         for n in self.cut_names:
+            var = block.var(n)
             v = scope.find_var(n)
             if v is not None:
                 shp = np.shape(v)[1:]
             else:
-                shp = tuple(int(d) for d in block.var(n).shape[1:])
+                shp = tuple(int(d) for d in var.shape[1:])
             widths.append(int(np.prod(shp)) if shp else 1)
-            dtypes.append(jnp.float32)
-        return max(widths), jnp.float32
+            dtypes.append(jnp.dtype(datatypes.as_numpy_dtype(var.dtype)))
+        if len(set(dtypes)) > 1:
+            raise ValueError(
+                "cut vars mix dtypes %s — the stage interface needs one"
+                % sorted({str(d) for d in dtypes}))
+        return max(widths), dtypes[0]
 
-    def _stage_fn(self, s, mb, width, cut_shapes):
+    def _stage_fn(self, s, mb, width, cut_shapes, idt):
         """Build stage s's branch: (params_tuple, x_flat, mb_feeds, m)
         -> (y_flat, loss_mb).  The per-microbatch PRNG key rides the
         feed stream (``__rng__``, derived from the executor's
@@ -180,13 +200,13 @@ class PipelineTranspiler(object):
             for i, op in enumerate(ops):
                 _run_one(op, env, ctx, i)
             if cut_out is not None:
-                y = env[cut_out].reshape(mb, -1).astype(jnp.float32)
+                y = env[cut_out].reshape(mb, -1).astype(idt)
                 pad = width - y.shape[1]
                 if pad:
                     y = jnp.pad(y, ((0, 0), (0, pad)))
                 loss = jnp.float32(0.0)
             else:
-                y = jnp.zeros((mb, width), jnp.float32)
+                y = jnp.zeros((mb, width), idt)
                 loss = jnp.sum(env[loss_name]).astype(jnp.float32)
             return y, loss
 
@@ -214,7 +234,6 @@ class PipelineTranspiler(object):
                                     mesh.shape[self.pp_axis], S))
         M = int(num_microbatches)
 
-        block = self.program.global_block()
         feeds = {}
         for name, value in feed.items():
             arr = np.asarray(value)
@@ -264,7 +283,7 @@ class PipelineTranspiler(object):
             else:
                 cut_shapes.append(
                     (mb,) + tuple(int(d) for d in block.var(n).shape[1:]))
-        stage_fns = [self._stage_fn(s, mb, width, cut_shapes)
+        stage_fns = [self._stage_fn(s, mb, width, cut_shapes, idt)
                      for s in range(S)]
         prog = self.program
         post_ops = self.post_ops
@@ -276,7 +295,7 @@ class PipelineTranspiler(object):
         def pipe_body(params_tuple, feeds):
             return pipeline_train_1f1b(
                 stage_fns, params_tuple, feeds, M, pp_axis,
-                (mb, width), jnp.float32)
+                (mb, width), idt)
 
         pipe = collective.shard_map(
             pipe_body, mesh=mesh, in_specs=(P(), P()),

@@ -9,13 +9,18 @@ __all__ = ['build']
 
 
 def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2,
-          dtype='float32'):
+          dtype='float32', fuse_vocab_loss=True):
     """Returns (src, target, avg_cost).  src/target are token-id sequences
     (lod_level=1); target is src shifted by one.
 
     dtype='bfloat16' runs the projection/vocab-head matmuls in bf16 with
     fp32 master weights (layers/nn.py fc keeps p_dtype fp32); the LSTM
-    recurrence and the softmax head stay fp32."""
+    recurrence and the softmax head stay fp32.  The loss defaults to
+    the fused vocab-projection + softmax-CE (ops/chunked_ce.py — only a
+    half-width logits residual in HBM, backward = softmax − onehot);
+    fuse_vocab_loss=False keeps the naive cross_entropy(softmax(x))
+    composition for A/B."""
+    from paddle_tpu.param_attr import ParamAttr
     src = fluid.layers.data(name='src', shape=[1], dtype='int64',
                             lod_level=1)
     target = fluid.layers.data(name='target', shape=[1], dtype='int64',
@@ -29,14 +34,24 @@ def build(vocab_size, emb_dim=128, hidden_dim=256, num_layers=2,
                              num_flatten_dims=2)
         h, _ = fluid.layers.dynamic_lstm(input=fc, size=hidden_dim * 4)
         x = h
-    # vocab-head matmul in the activation dtype; softmax in fp32
-    logits = fluid.layers.fc(input=x, size=vocab_size, num_flatten_dims=2,
-                             act=None)
-    if dtype in ('bfloat16', 'float16'):
-        logits = fluid.layers.cast(x=logits, dtype='float32')
-    probs = fluid.layers.softmax(x=logits)
-    cost = fluid.layers.cross_entropy(input=probs, label=target,
-                                      soft_label=False)
+    if fuse_vocab_loss:
+        # head params carry fixed names so an inference/decode build
+        # (the fc path below) reuses the trained weights
+        cost = fluid.layers.fused_linear_softmax_ce(
+            input=x, label=target, size=vocab_size, num_flatten_dims=2,
+            param_attr=ParamAttr(name='lm_out_w'),
+            bias_attr=ParamAttr(name='lm_out_b'))
+    else:
+        # vocab-head matmul in the activation dtype; softmax in fp32
+        logits = fluid.layers.fc(
+            input=x, size=vocab_size, num_flatten_dims=2, act=None,
+            param_attr=ParamAttr(name='lm_out_w'),
+            bias_attr=ParamAttr(name='lm_out_b'))
+        if dtype in ('bfloat16', 'float16'):
+            logits = fluid.layers.cast(x=logits, dtype='float32')
+        probs = fluid.layers.softmax(x=logits)
+        cost = fluid.layers.cross_entropy(input=probs, label=target,
+                                          soft_label=False)
     # mask out padded steps via sequence-average
     avg_cost = fluid.layers.mean(
         x=fluid.layers.sequence_pool(input=cost, pool_type='average'))

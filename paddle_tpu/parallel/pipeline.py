@@ -172,8 +172,10 @@ def pipeline_train_1f1b(stage_fns, params_tuple, feeds, num_microbatches,
                               [(i + 1, i) for i in range(S - 1)])
         return (fwd_buf, ct_buf, ring, dparams, loss_acc), None
 
+    # cotangents carry the primal's dtype (bf16 activations get bf16
+    # cotangents, like any jax vjp)
     fwd0 = _varying(jnp.zeros(iface_shape, iface_dtype), axis_name)
-    ct0 = _varying(jnp.zeros(iface_shape, jnp.float32), axis_name)
+    ct0 = _varying(jnp.zeros(iface_shape, iface_dtype), axis_name)
     ring0 = _varying(jnp.zeros((ring_slots,) + tuple(iface_shape),
                                iface_dtype), axis_name)
     dparams0 = jax.tree_util.tree_map(

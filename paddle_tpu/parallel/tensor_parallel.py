@@ -5,12 +5,15 @@ matmul keeps the activation sharded on features; row-parallel matmul
 psums partial products over 'tp' — one ICI allreduce per pair, the same
 schedule Megatron-LM uses.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 __all__ = ['column_parallel_matmul', 'row_parallel_matmul',
-           'parallel_embedding', 'tp_fc_pair']
+           'parallel_embedding', 'tp_fc_pair',
+           'vocab_parallel_cross_entropy']
 
 
 def column_parallel_matmul(x, w_shard, b_shard=None):
@@ -53,3 +56,53 @@ def tp_fc_pair(x, w1_shard, w2_shard, axis_name, act=jax.nn.relu):
     row-parallel fc = ONE psum for two matmuls."""
     h = act(column_parallel_matmul(x, w1_shard))
     return row_parallel_matmul(h, w2_shard, axis_name)
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _pmax_nodiff(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+@_pmax_nodiff.defjvp
+def _pmax_nodiff_jvp(axis_name, primals, tangents):
+    (x,), _ = primals, tangents
+    return lax.pmax(x, axis_name), jnp.zeros_like(x)
+
+
+def vocab_parallel_cross_entropy(x, w_shard, b_shard, labels, axis_name):
+    """Softmax cross-entropy through a VOCAB-SHARDED head: W is split
+    [D, V/k] per member along ``axis_name``, so neither the full [D, V]
+    head nor the full [N, V] logits ever exist on one chip — the
+    multi-chip lever PERF.md names for the seq2seq vocab wall (the
+    single-chip fused op is ops/chunked_ce.py).
+
+    Per member: local logits [N, V/k], local max and sum-exp; the
+    global logsumexp combines with one pmax + one psum, and the label
+    logit is a masked gather psum'd from whichever member owns the
+    label's shard.  Backward flows through the psums automatically
+    (the stabilizing pmax rides outside differentiation), producing the
+    local dW shard and a psum'd dx — call inside shard_map,
+    differentiable.
+
+    :param labels: [N] int32 GLOBAL vocab ids (replicated).
+    :returns: per-example loss [N] (replicated across the axis).
+    """
+    rank = lax.axis_index(axis_name)
+    vs = w_shard.shape[1]
+    logits = jnp.matmul(x, w_shard.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = logits.astype(jnp.float32) + b_shard.astype(jnp.float32)
+    # the max is a pure numerical stabilizer (the logsumexp gradient is
+    # shift-invariant), so it rides outside differentiation — pmax has
+    # no transpose rule and needs none here
+    local_max = lax.stop_gradient(jnp.max(logits, axis=1))
+    gmax = _pmax_nodiff(local_max, axis_name)
+    gsum = lax.psum(jnp.sum(jnp.exp(logits - gmax[:, None]), axis=1),
+                    axis_name)
+    lse = gmax + jnp.log(gsum)
+    local = labels.astype(jnp.int32) - rank * vs
+    hit = (local >= 0) & (local < vs)
+    lg = jnp.take_along_axis(
+        logits, jnp.clip(local, 0, vs - 1)[:, None], axis=1)[:, 0]
+    label_logit = lax.psum(jnp.where(hit, lg, 0.0), axis_name)
+    return lse - label_logit

@@ -214,3 +214,39 @@ def test_seq2seq_fused_loss_matches_dense_build():
                         for f in feeds]
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-4,
                                atol=1e-5)
+
+
+def test_rnn_lm_fused_loss_matches_naive_build():
+    """The stacked-LSTM LM's fused vocab loss tracks the naive
+    cross_entropy(softmax(x)) build step-for-step (fp32)."""
+    from paddle_tpu.core.program import reset_unique_name_guard
+    from paddle_tpu.models import rnn_lm
+
+    def build(fuse):
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 3
+            with fluid.program_guard(main, startup):
+                src, target, avg_cost = rnn_lm.build(
+                    vocab_size=60, emb_dim=8, hidden_dim=12,
+                    fuse_vocab_loss=fuse)
+                fluid.optimizer.AdagradOptimizer(0.1).minimize(avg_cost)
+        return main, startup, avg_cost
+
+    rng = np.random.RandomState(4)
+    b, t = 4, 6
+    ln = np.full((b,), t, np.int32)
+    feeds = [{'src': (rng.randint(1, 60, (b, t, 1)), ln),
+              'target': (rng.randint(1, 60, (b, t, 1)), ln)}
+             for _ in range(3)]
+
+    losses = {}
+    for fuse in (False, True):
+        main, startup, avg_cost = build(fuse)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses[fuse] = [float(np.ravel(exe.run(main, feed=f,
+                                               fetch_list=[avg_cost])[0])[0])
+                        for f in feeds]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-4,
+                               atol=1e-5)

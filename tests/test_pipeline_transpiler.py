@@ -100,7 +100,7 @@ def test_pipeline_microbatch_invariance():
 
 def test_pipeline_transpile_validation():
     """Bad cuts and unsupported programs fail loudly at transpile."""
-    need_devices(1)
+    need_devices(4)
     main, startup, loss, cuts = _build_mlp()
     with pytest.raises(ValueError, match='cut_vars'):
         PipelineTranspiler().transpile(main, cut_vars=[])
@@ -167,3 +167,73 @@ def test_pipeline_dropout_prng_chain():
     # lr=0 keeps params fixed: loss differences are purely dropout masks
     assert a[0] != a[1], "step chain must advance the dropout stream"
     np.testing.assert_allclose(a, b, rtol=1e-6)  # reproducible
+
+
+def test_pipeline_bf16_interface_matches_single_device():
+    """Code-review r4: a bf16 program's cut activations cross stage
+    boundaries IN bf16 (not silently promoted to fp32) — the pipelined
+    loss matches the same bf16 program on one device."""
+    need_devices(4)
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 7
+            cuts = []
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[12],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                h = fluid.layers.cast(x=x, dtype='bfloat16')
+                for _ in range(2):
+                    h = fluid.layers.fc(input=h, size=16, act='tanh')
+                    cuts.append(h)
+                pred = fluid.layers.fc(input=h, size=1)
+                predf = fluid.layers.cast(x=pred, dtype='float32')
+                loss = fluid.layers.mean(
+                    x=fluid.layers.square_error_cost(input=predf,
+                                                     label=y))
+                fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return main, startup, loss, cuts
+
+    batches = _batches(2)
+    main, startup, loss, cuts = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want = [float(np.ravel(exe.run(main, feed=f,
+                                   fetch_list=[loss])[0])[0])
+            for f in batches]
+
+    main, startup, loss, cuts = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    t = PipelineTranspiler().transpile(main, cut_vars=cuts)
+    import jax.numpy as jnp
+    assert t._iface(fluid.global_scope())[1] == jnp.bfloat16
+    mesh = api.make_mesh((3,), ('pp',))
+    with api.mesh_guard(mesh):
+        got = [float(t.run_step(exe, feed=f, num_microbatches=4))
+               for f in batches]
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=1e-3)
+
+
+def test_pipeline_rejects_sparse_embeddings():
+    """Code-review r4: is_sparse embeddings fail at transpile with a
+    clear error, not a KeyError inside the jit trace."""
+    need_devices(1)
+    with reset_unique_name_guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data(name='ids', shape=[1],
+                                    dtype='int64')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            emb = fluid.layers.embedding(input=ids, size=[50, 8],
+                                         is_sparse=True)
+            c1 = fluid.layers.fc(input=emb, size=8, act='tanh')
+            pred = fluid.layers.fc(input=c1, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    with pytest.raises(ValueError, match='is_sparse'):
+        PipelineTranspiler().transpile(main, cut_vars=[c1])
