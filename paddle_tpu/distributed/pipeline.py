@@ -112,13 +112,14 @@ class PipelineTranspiler(object):
         self.num_stages = S
 
         # classify every stage input: produced upstream (must be the
-        # stage's cut), a parameter/persistable, or a data feed
+        # stage's cut), a parameter/persistable, or a data feed (@LEN
+        # companions of ragged data vars are data vars themselves —
+        # layers/io.py creates them with is_data=True)
         persist = {v.name for v in program.list_vars() if v.persistable}
         self.data_names = sorted({
             v.name for v in program.list_vars()
             if getattr(v, 'is_data', False)})
         self.stage_params = []
-        produced = set()
         for s in range(S):
             outs = set()
             for op in stage_ops[s]:
@@ -234,15 +235,29 @@ class PipelineTranspiler(object):
                                     mesh.shape[self.pp_axis], S))
         M = int(num_microbatches)
 
-        feeds = {}
+        # expand feed entries exactly like the executor (ragged
+        # (data, lengths) tuples and LoDTensors become the padded array
+        # plus an @LEN companion), then split every array into M
+        # microbatches along the batch axis — the lengths stream with
+        # their data
+        from ..core.executor import _to_feed_arrays
+        block = self.program.global_block()
+        flat = {}
         for name, value in feed.items():
-            arr = np.asarray(value)
+            flat.update(_to_feed_arrays(name, value,
+                                        block.vars.get(name)))
+        feeds = {}
+        for name, value in flat.items():
+            # keep device-resident arrays on device (the reshape is
+            # metadata-only); np.asarray would round-trip them to host
+            arr = value if isinstance(value, jax.Array) \
+                else np.asarray(value)
             if arr.shape[0] % M:
                 raise ValueError(
                     "batch %d does not split into %d microbatches"
                     % (arr.shape[0], M))
             feeds[name] = arr.reshape((M, arr.shape[0] // M)
-                                      + arr.shape[1:])
+                                      + tuple(arr.shape[1:]))
         mb = next(iter(feeds.values())).shape[1]
 
         persist_names = sorted(

@@ -237,3 +237,53 @@ def test_pipeline_rejects_sparse_embeddings():
             fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
     with pytest.raises(ValueError, match='is_sparse'):
         PipelineTranspiler().transpile(main, cut_vars=[c1])
+
+
+def test_pipeline_ragged_feeds_stream_with_lengths():
+    """Ragged (data, lengths) feeds work pipelined: the @LEN companions
+    split into microbatches alongside their data, sequence ops inside a
+    stage mask correctly, and the loss matches single-device."""
+    need_devices(2)
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 13
+            with fluid.program_guard(main, startup):
+                ids = fluid.layers.data(name='ids', shape=[1],
+                                        dtype='int64', lod_level=1)
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                emb = fluid.layers.embedding(input=ids, size=[40, 8])
+                pooled = fluid.layers.sequence_pool(input=emb,
+                                                    pool_type='average')
+                c1 = fluid.layers.fc(input=pooled, size=12, act='tanh')
+                pred = fluid.layers.fc(input=c1, size=1)
+                loss = fluid.layers.mean(
+                    x=fluid.layers.square_error_cost(input=pred,
+                                                     label=y))
+                fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return main, startup, loss, [c1]
+
+    rng = np.random.RandomState(9)
+    b, t = 8, 6
+    ids = rng.randint(1, 40, (b, t, 1)).astype('int64')
+    ln = rng.randint(1, t + 1, (b,)).astype('int32')  # genuinely ragged
+    feed = {'ids': (ids, ln), 'y': rng.randn(b, 1).astype('float32')}
+
+    main, startup, loss, cuts = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want = [float(np.ravel(exe.run(main, feed=feed,
+                                   fetch_list=[loss])[0])[0])
+            for _ in range(2)]
+
+    main, startup, loss, cuts = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    t2 = PipelineTranspiler().transpile(main, cut_vars=cuts)
+    mesh = api.make_mesh((2,), ('pp',))
+    with api.mesh_guard(mesh):
+        got = [float(t2.run_step(exe, feed=feed, num_microbatches=4))
+               for _ in range(2)]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
