@@ -24,6 +24,11 @@ TPU-native design decisions:
   backward/optimize-role ops (grad clip, regularizers, sgd/adam, LR
   schedules) then run on it — any optimizer the Program was built
   with works unchanged.
+- A second mesh axis composes as DATA-PARALLEL replicas of the whole
+  pipeline: microbatch contents shard over it, loss/grads pmean, and
+  each replica folds its dp index into the PRNG keys (the ParallelDo
+  convention).  Deterministic programs train with exact single-device
+  parity; stochastic ones draw distinct per-replica randomness.
 - The per-microbatch loss must be an example-mean (fluid's
   `mean(...)` convention): the pipeline's total is the mean over
   microbatches, which equals the full-batch loss when the batch splits
@@ -233,6 +238,17 @@ class PipelineTranspiler(object):
                 "mesh axis %r has %d members but the program was cut "
                 "into %d stages" % (self.pp_axis,
                                     mesh.shape[self.pp_axis], S))
+        # any second mesh axis runs data-parallel REPLICAS of the
+        # pipeline: microbatch contents shard over it, grads pmean
+        other = [a for a in mesh.axis_names if a != self.pp_axis
+                 and mesh.shape[a] > 1]
+        if len(other) > 1:
+            raise ValueError(
+                "mesh %s has more than one non-pp axis %s — compose "
+                "pp with at most one dp axis" % (dict(mesh.shape),
+                                                 other))
+        dp_axis = other[0] if other else None
+        dp = mesh.shape[dp_axis] if dp_axis else 1
         M = int(num_microbatches)
 
         # expand feed entries exactly like the executor (ragged
@@ -252,10 +268,10 @@ class PipelineTranspiler(object):
             # metadata-only); np.asarray would round-trip them to host
             arr = value if isinstance(value, jax.Array) \
                 else np.asarray(value)
-            if arr.shape[0] % M:
+            if arr.shape[0] % (M * dp):
                 raise ValueError(
-                    "batch %d does not split into %d microbatches"
-                    % (arr.shape[0], M))
+                    "batch %d does not split into %d microbatches x "
+                    "%d dp replicas" % (arr.shape[0], M, dp))
             feeds[name] = arr.reshape((M, arr.shape[0] // M)
                                       + tuple(arr.shape[1:]))
         mb = next(iter(feeds.values())).shape[1]
@@ -268,7 +284,8 @@ class PipelineTranspiler(object):
                             for n, v in feeds.items())), mesh)
         plan = self._plan_cache.get(key)
         if plan is None:
-            plan = self._build_plan(mesh, M, mb, feeds, persist_names)
+            plan = self._build_plan(mesh, M, mb, feeds, persist_names,
+                                    dp_axis)
             self._plan_cache[key] = plan
         fn = plan
 
@@ -285,8 +302,12 @@ class PipelineTranspiler(object):
             scope.set(n, v)
         return np.asarray(loss)
 
-    def _build_plan(self, mesh, M, mb, feeds, persist_names):
+    def _build_plan(self, mesh, M, mb, feeds, persist_names,
+                    dp_axis=None):
+        from jax import lax
         S = self.num_stages
+        dp = mesh.shape[dp_axis] if dp_axis else 1
+        mb_local = mb // dp  # examples per microbatch per dp replica
         width, idt = self._iface(global_scope())
         block = self.program.global_block()
         scope = global_scope()
@@ -294,11 +315,12 @@ class PipelineTranspiler(object):
         for n in self.cut_names:
             v = scope.find_var(n)
             if v is not None:
-                cut_shapes.append((mb,) + tuple(np.shape(v)[1:]))
+                cut_shapes.append((mb_local,) + tuple(np.shape(v)[1:]))
             else:
                 cut_shapes.append(
-                    (mb,) + tuple(int(d) for d in block.var(n).shape[1:]))
-        stage_fns = [self._stage_fn(s, mb, width, cut_shapes, idt)
+                    (mb_local,) + tuple(int(d)
+                                        for d in block.var(n).shape[1:]))
+        stage_fns = [self._stage_fn(s, mb_local, width, cut_shapes, idt)
                      for s in range(S)]
         prog = self.program
         post_ops = self.post_ops
@@ -308,12 +330,33 @@ class PipelineTranspiler(object):
         pp_axis = self.pp_axis
 
         def pipe_body(params_tuple, feeds):
-            return pipeline_train_1f1b(
+            if dp_axis is not None:
+                # distinct randomness per dp replica (each holds
+                # different examples) — the ParallelDo convention of
+                # folding the member index into the key
+                r = lax.axis_index(dp_axis)
+                feeds = dict(feeds)
+                feeds['__rng__'] = jax.vmap(
+                    lambda k2: jax.random.fold_in(k2, r))(
+                        feeds['__rng__'])
+            loss, grads = pipeline_train_1f1b(
                 stage_fns, params_tuple, feeds, M, pp_axis,
-                (mb, width), idt)
+                (mb_local, width), idt)
+            if dp_axis is not None:
+                # each replica's loss/grads are means over ITS examples;
+                # the global mean is their pmean
+                loss = lax.pmean(loss, dp_axis)
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, dp_axis), grads)
+            return loss, grads
 
+        # microbatch CONTENTS shard over dp (axis 1 of [M, mb, ...]);
+        # the per-microbatch PRNG keys and params replicate
+        feed_specs = {n: P(None, dp_axis) if dp_axis else P()
+                      for n in feeds}
+        feed_specs['__rng__'] = P()
         pipe = collective.shard_map(
-            pipe_body, mesh=mesh, in_specs=(P(), P()),
+            pipe_body, mesh=mesh, in_specs=(P(), feed_specs),
             out_specs=(P(), P()), check_vma=False)
 
         def step(state, feeds, key0):

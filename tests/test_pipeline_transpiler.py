@@ -287,3 +287,59 @@ def test_pipeline_ragged_feeds_stream_with_lengths():
         got = [float(t2.run_step(exe, feed=feed, num_microbatches=4))
                for _ in range(2)]
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_composes_with_dp_axis():
+    """A (pp=2, dp=2) mesh runs data-parallel REPLICAS of the pipeline:
+    microbatch contents shard over dp, grads pmean — losses and updated
+    params match the same Program on one device."""
+    need_devices(4)
+
+    def build():
+        with reset_unique_name_guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 17
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name='x', shape=[12],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                c1 = fluid.layers.fc(input=x, size=16, act='tanh')
+                pred = fluid.layers.fc(input=c1, size=1)
+                loss = fluid.layers.mean(
+                    x=fluid.layers.square_error_cost(input=pred,
+                                                     label=y))
+                fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+        return main, startup, loss, [c1]
+
+    batches = _batches(3)
+    main, startup, loss, cuts = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want = [float(np.ravel(exe.run(main, feed=f,
+                                   fetch_list=[loss])[0])[0])
+            for f in batches]
+
+    main, startup, loss, cuts = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    t = PipelineTranspiler().transpile(main, cut_vars=cuts)
+    mesh = api.make_mesh((2, 2), ('pp', 'dp'))
+    with api.mesh_guard(mesh):
+        got = [float(t.run_step(exe, feed=f, num_microbatches=4))
+               for f in batches]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    scope = fluid.global_scope()
+    pipe_params = {p.name: np.asarray(scope.find_var(p.name))
+                   for p in main.global_block().all_parameters()}
+    # params updated identically to the single-device run (same names
+    # via reset_unique_name_guard, so the rerun overwrites the scope)
+    main2, startup2, loss2, cuts2 = build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    for f in batches:
+        exe2.run(main2, feed=f, fetch_list=[loss2])
+    for n, v in pipe_params.items():
+        np.testing.assert_allclose(
+            v, np.asarray(scope.find_var(n)), rtol=1e-4, atol=1e-6,
+            err_msg=n)
