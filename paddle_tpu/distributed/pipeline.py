@@ -120,7 +120,6 @@ class PipelineTranspiler(object):
         # stage's cut), a parameter/persistable, or a data feed (@LEN
         # companions of ragged data vars are data vars themselves —
         # layers/io.py creates them with is_data=True)
-        persist = {v.name for v in program.list_vars() if v.persistable}
         self.data_names = sorted({
             v.name for v in program.list_vars()
             if getattr(v, 'is_data', False)})
@@ -133,9 +132,9 @@ class PipelineTranspiler(object):
             for op in stage_ops[s]:
                 ins.update(op.input_arg_names)
             ext = ins - outs
-            pp = sorted(n for n in ext if n in persist)
+            pp = sorted(n for n in ext if n in persistable)
             bad = [n for n in ext
-                   if n not in persist and n not in self.data_names
+                   if n not in persistable and n not in self.data_names
                    and not (s > 0 and n == self.cut_names[s - 1])]
             if bad:
                 raise ValueError(
@@ -143,7 +142,7 @@ class PipelineTranspiler(object):
                     "a parameter, nor a data feed — choose cuts so each "
                     "stage depends only on the previous cut" % (s, bad))
             for op in stage_ops[s]:
-                wp = [n for n in op.output_arg_names if n in persist]
+                wp = [n for n in op.output_arg_names if n in persistable]
                 if wp:
                     raise ValueError(
                         "stage %d op %s writes persistable %s — "

@@ -70,15 +70,21 @@ def export_inference(path, feed_shapes, target_vars, executor=None,
     return len(blob)
 
 
+def _open_exported(path):
+    """Deserialize a StableHLO artifact and jit its call ONCE — the one
+    place the open/deserialize/jit sequence lives (load_exported and
+    InferenceServer both build on it).  The jit cache matters: bare
+    exported.call re-traces (and re-compiles) on every invocation —
+    measured 4s/call vs 2ms for ResNet-50 b8."""
+    with open(path, 'rb') as f:
+        exported = jax_export.deserialize(f.read())
+    return exported, jax.jit(exported.call)
+
+
 def load_exported(path):
     """Load a StableHLO artifact; returns fn({name: array}) -> [outputs].
     Requires only jax/XLA — not the framework that exported it."""
-    with open(path, 'rb') as f:
-        exported = jax_export.deserialize(f.read())
-
-    # cache the jit: bare exported.call re-traces (and re-compiles) on
-    # every invocation — measured 4s/call vs 2ms for ResNet-50 b8
-    call = jax.jit(exported.call)
+    _exported, call = _open_exported(path)
 
     def run(feed):
         key = jax.random.PRNGKey(0)
@@ -106,9 +112,7 @@ class InferenceServer(object):
       cached per (K, shapes)."""
 
     def __init__(self, path):
-        with open(path, 'rb') as f:
-            self._exported = jax_export.deserialize(f.read())
-        self._call = jax.jit(self._exported.call)
+        self._exported, self._call = _open_exported(path)
         self._key = jax.random.PRNGKey(0)
         exported, key = self._exported, self._key
 

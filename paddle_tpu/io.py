@@ -28,7 +28,11 @@ from .core.scope import global_scope
 _MANIFEST = '__manifest__.json'
 # v2: shard records carry index-derived filenames (strings, not counters)
 # and multi-host saves write per-process __manifest__.p<K>.json files
-_FORMAT_VERSION = 2
+# v3: data filenames carry the save generation (``w.shard.g5.0_4x0_8.npy``,
+# ``w.g5.npy``) so a crash between the data writes and the manifest write
+# can never tear an older checkpoint's files in place; generations older
+# than the newest two are garbage-collected after the manifest lands
+_FORMAT_VERSION = 3
 
 __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
@@ -61,13 +65,18 @@ def _sharding_of(value):
     return spec, sh.mesh
 
 
-def _shard_filename(name, idx):
-    """Deterministic shard filename derived from the global index bounds
-    (``v.shard.0_4x8_16.npy`` = rows [0,4) × cols [8,16)), so concurrent
-    hosts writing their own shards of the same var never collide and a
-    re-save of the same block overwrites in place."""
+def _shard_filename(name, idx, gen=None):
+    """Deterministic shard filename derived from the save generation and
+    the global index bounds (``v.shard.g5.0_4x8_16.npy`` = generation 5,
+    rows [0,4) × cols [8,16)): concurrent hosts writing their own shards
+    of the same var never collide, replicas of one block within a
+    generation overwrite in place (benign — identical content, atomic
+    rename), and a NEWER save never touches an older generation's files,
+    so a crash before the manifest write leaves the previous checkpoint
+    fully intact."""
     span = 'x'.join('%d_%d' % (a, b) for a, b in idx)
-    return '%s.shard.%s.npy' % (_safe(name), span or 'scalar')
+    g = '' if gen is None else 'g%d.' % gen
+    return '%s.shard.%s%s.npy' % (_safe(name), g, span or 'scalar')
 
 
 def _process_index():
@@ -97,7 +106,7 @@ def _blocks_overlap(idx, jdx):
     return all(a < d and c < b for (a, b), (c, d) in zip(idx, jdx))
 
 
-def _save_sharded(dirname, name, value):
+def _save_sharded(dirname, name, value, gen=None):
     """One .npy per unique addressable shard (dedup replicated copies by
     index); returns the manifest shard records.  Indices are normalized
     to concrete (start, stop) bounds — jax yields slice(None) for
@@ -114,7 +123,7 @@ def _save_sharded(dirname, name, value):
         if idx in seen:
             continue
         seen.add(idx)
-        fname = _shard_filename(name, idx)
+        fname = _shard_filename(name, idx, gen)
         _atomic_save(os.path.join(dirname, fname), shard.data)
         records.append({'index': [list(p) for p in idx], 'file': fname})
     return records
@@ -133,11 +142,13 @@ def _merge_var_record(old, new):
     shape/dtype/spec agree — old blocks overlapping any new block are
     superseded (a re-tiling) — and resolve to ``new`` wholesale when the
     metadata differs."""
-    if old is None or 'shards' not in old or 'shards' not in new:
+    if old is None:
         return new
     og, ng = old.get('gen'), new.get('gen')
     if og is not None and ng is not None and og != ng:
         return new if ng > og else old
+    if 'shards' not in old or 'shards' not in new:
+        return new
     if any(old.get(k) != new.get(k) for k in ('shape', 'dtype', 'spec')):
         return new
     new_indices = [tuple(tuple(p) for p in s['index'])
@@ -171,8 +182,11 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     # copying siblings' shard records into our manifest would let a torn
     # later checkpoint (another host crashing mid-save) pass the
     # load-time completeness check on our stale copy of its records.
-    manifest = _read_manifest(dirname, own_only=True) or {
-        'format_version': _FORMAT_VERSION, 'vars': {}}
+    manifest = _read_manifest(dirname, own_only=True) or {'vars': {}}
+    # re-stamp: a manifest seeded from an older-format dir now carries
+    # v3 records — a v2 reader must hit the format gate, not silently
+    # fall back to the stale legacy files v3 saves never update
+    manifest['format_version'] = _FORMAT_VERSION
     if generation is None:
         # Save generation: one past the newest in the WHOLE directory
         # (all manifests — a process's own history alone diverges when
@@ -216,14 +230,80 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             # the current addressable set IS this host's complete view,
             # and unioning with stale own records would let an old block
             # survive a shard-ownership change (mixing generations)
-            rec['shards'] = _save_sharded(dirname, name, value)
+            rec['shards'] = _save_sharded(dirname, name, value, gen)
         else:
-            # replicated vars: every host writes the same <name>.npy with
-            # identical content; atomicity makes the race benign
-            _atomic_save(os.path.join(dirname, _safe(name) + '.npy'),
-                         value)
+            # replicated vars: every host writes the same generation file
+            # with identical content; atomicity makes the race benign
+            fname = '%s.g%d.npy' % (_safe(name), gen)
+            rec['file'] = fname
+            _atomic_save(os.path.join(dirname, fname), value)
         manifest['vars'][name] = rec
     _write_manifest(dirname, manifest)
+    _gc_stale_generations(
+        dirname,
+        [var.name if isinstance(var, Variable) else var for var in vars],
+        floor_gen=gen)
+
+
+def _referenced_generations(dirname):
+    """Set of save generations referenced by ANY manifest in the
+    directory — live per-process manifests and their ``.prev``
+    archives.  GC never deletes a file belonging to one of these, so a
+    lagging sibling's live checkpoint and the archived rollback stay
+    loadable regardless of how generation numbers are spaced."""
+    import glob
+    gens = set()
+    esc = glob.escape(dirname)
+    paths = (glob.glob(os.path.join(esc, '__manifest__*.json')) +
+             glob.glob(os.path.join(esc, '__manifest__*.json.prev')))
+    for path in paths:
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for rec in m.get('vars', {}).values():
+            g = rec.get('gen')
+            if g is not None:
+                gens.add(int(g))
+    return gens
+
+
+def _gc_stale_generations(dirname, names, floor_gen):
+    """Delete a var's generation-suffixed data files whose generation is
+    (a) below ``floor_gen`` — the save that just completed; gens at or
+    above it may belong to a synchronized sibling still mid-write — and
+    (b) referenced by no manifest in the directory (live or ``.prev``
+    archive, see _referenced_generations).  This sweeps torn generations
+    (data files whose save crashed before its manifest) without ever
+    widowing the archived rollback checkpoint or a lagging sibling's
+    files.  Runs AFTER the manifest write, so a crash-interrupted sweep
+    only leaves unreferenced files behind — restartable.  Legacy
+    un-suffixed files are never touched.  One pass over the directory:
+    each filename is parsed once, matched against the saved-var set, and
+    deleted iff its generation is both below the floor and
+    unreferenced."""
+    import re
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return
+    keep_gens = _referenced_generations(dirname)
+    # non-greedy name + backtracking splits the gen suffix correctly
+    # even for var names that themselves contain dots
+    pat = re.compile(
+        r'^(.+?)\.(?:shard\.g(\d+)\.(?:[0-9_x]+|scalar)|g(\d+))\.npy$')
+    wanted = {_safe(n) for n in names}
+    for fname in entries:
+        m = pat.match(fname)
+        if not m or m.group(1) not in wanted:
+            continue
+        g = int(m.group(2) or m.group(3))
+        if g < floor_gen and g not in keep_gens:
+            try:
+                os.remove(os.path.join(dirname, fname))
+            except OSError:
+                pass
 
 
 def _own_manifest_name():
@@ -254,11 +334,49 @@ def _write_manifest(dirname, manifest):
     tmp = path + '.tmp'
     with open(tmp, 'w') as f:
         json.dump(manifest, f)
+    # archive the manifest being superseded as <fname>.prev (hardlink:
+    # no window with zero manifests) — together with _gc_stale_generations
+    # keeping its referenced data files, renaming it back restores the
+    # previous checkpoint.  Archived only when this write ADVANCES the
+    # newest generation: a checkpoint composed of several save_vars
+    # calls into one manifest (per-member saves) archives once, at the
+    # first write of the new generation, so .prev is always the last
+    # COMPLETE previous checkpoint, never a mid-checkpoint intermediate.
+    # .prev does not match the __manifest__*.json read glob, so loads
+    # never see it.
+    if os.path.exists(path) and _advances_generation(path, manifest):
+        prev = path + '.prev'
+        try:
+            if os.path.exists(prev + '.tmp'):
+                os.remove(prev + '.tmp')  # crashed earlier attempt
+            os.link(path, prev + '.tmp')
+            os.replace(prev + '.tmp', prev)
+        except OSError:
+            pass
     os.replace(tmp, path)
     if fname == _MANIFEST:
+        # .p*.json AND their .prev/.tmp leftovers: a surviving archive
+        # would pin its generations against GC forever
         for stale in glob.glob(os.path.join(glob.escape(dirname),
-                                            '__manifest__.p*.json')):
-            os.remove(stale)
+                                            '__manifest__.p*.json*')):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass  # a straggler's os.replace can race .tmp names away
+
+
+def _advances_generation(path, manifest):
+    """True when ``manifest`` carries a newer save generation than the
+    manifest file at ``path`` (unreadable/legacy files count as gen 0)."""
+    def newest(m):
+        return max([r.get('gen', 0) or 0
+                    for r in m.get('vars', {}).values()] + [0])
+    try:
+        with open(path) as f:
+            on_disk = json.load(f)
+    except (OSError, ValueError):
+        return True
+    return newest(manifest) > newest(on_disk)
 
 
 def _read_manifest(dirname, own_only=False):
@@ -446,7 +564,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         if rec is not None and rec.get('shards'):
             value = _load_sharded(dirname, name, rec)
         else:
-            path = os.path.join(dirname, _safe(name) + '.npy')
+            # generation-suffixed filename from the record (format v3);
+            # the legacy un-suffixed name serves ONLY records that never
+            # carried a filename (v2 checkpoints, manifest-less dirs) —
+            # when a v3 record names a file that is missing, the var is
+            # skipped rather than silently restored from a stale legacy
+            # copy the v3 saves never updated
+            if rec is not None and rec.get('file'):
+                path = os.path.join(dirname, rec['file'])
+            else:
+                path = os.path.join(dirname, _safe(name) + '.npy')
             if not os.path.exists(path):
                 continue
             value = (_np_load(path, rec['dtype']) if rec is not None

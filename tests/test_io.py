@@ -412,3 +412,116 @@ def test_embedding_lookup_and_padding_idx():
                                 {'padding_idx': 0})['Out'][0])
     assert np.all(got_pad[2] == 0)
     np.testing.assert_allclose(got_pad[:2], w[[1, 9]], rtol=1e-6)
+
+
+def test_crash_before_manifest_preserves_old_checkpoint(tmp_path,
+                                                        monkeypatch):
+    """A save that dies AFTER writing data files but BEFORE the manifest
+    write must leave the previous checkpoint fully intact: generation-
+    suffixed filenames (format v3) mean the newer data never overwrites
+    the files the surviving manifest references, so the reload is the
+    complete older state — not a silent mix of generations."""
+    import pytest
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    _train_steps(exe, main, loss, 2)
+    ckpt = str(tmp_path / 'torn')
+    io.save_checkpoint(exe, ckpt, main, step=1)
+    scope = fluid.global_scope()
+    saved = {v.name: np.asarray(scope.find_var(v.name)).copy()
+             for v in main.list_vars()
+             if v.persistable and scope.find_var(v.name) is not None}
+    assert saved
+
+    # train on, then crash mid-save: data files land, manifest does not
+    _train_steps(exe, main, loss, 2, seed=1)
+    drifted = any(
+        not np.array_equal(np.asarray(scope.find_var(n)), saved[n])
+        for n in saved)
+    assert drifted  # the interrupted save really carries new values
+
+    def no_manifest(dirname, manifest):
+        raise RuntimeError('killed before manifest write')
+
+    monkeypatch.setattr(io, '_write_manifest', no_manifest)
+    with pytest.raises(RuntimeError, match='killed'):
+        io.save_checkpoint(exe, ckpt, main, step=2)
+    monkeypatch.undo()
+
+    for name, val in saved.items():
+        scope.set(name, np.zeros_like(val))
+    step = io.load_checkpoint(exe, ckpt, main)
+    assert step == 1
+    for name, val in saved.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)), val, err_msg=name)
+
+    # recovery: training resumes and a LATER save succeeds — GC sweeps
+    # the torn generation (gen 3, referenced by no manifest) but keeps
+    # the generation the archived .prev manifest references (gen 2),
+    # which still restores the step-1 state
+    import glob
+    import os
+    import re
+    _train_steps(exe, main, loss, 1, seed=2)
+    io.save_checkpoint(exe, ckpt, main, step=3)
+    gens = {int(m.group(1))
+            for f in glob.glob(ckpt + '/*.npy')
+            for m in [re.search(r'\.g(\d+)\.', os.path.basename(f))]
+            if m}
+    assert gens == {2, 4}, gens
+    os.replace(os.path.join(ckpt, '__manifest__.json.prev'),
+               os.path.join(ckpt, '__manifest__.json'))
+    io.load_persistables(exe, ckpt, main)
+    for name, val in saved.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)), val, err_msg=name)
+
+
+def test_generation_gc_keeps_rollback(tmp_path):
+    """Repeated saves into one directory keep only the newest two
+    generations' data files — the immediately-previous checkpoint
+    survives as rollback, older ones are swept — and the current
+    manifest always references live files."""
+    import glob
+    import os
+    import re
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ckpt = str(tmp_path / 'gc')
+    scope = fluid.global_scope()
+
+    def snapshot():
+        return {v.name: np.asarray(scope.find_var(v.name)).copy()
+                for v in main.list_vars()
+                if v.persistable and scope.find_var(v.name) is not None}
+
+    at_step = {}
+    for step in (1, 2, 3):
+        _train_steps(exe, main, loss, 1, seed=step)
+        io.save_checkpoint(exe, ckpt, main, step=step)
+        at_step[step] = snapshot()
+    gens = {int(m.group(1))
+            for f in glob.glob(ckpt + '/*.npy')
+            for m in [re.search(r'\.g(\d+)\.', os.path.basename(f))]
+            if m}
+    assert gens == {3, 4}, gens  # steps 2,3 -> generations 3,4
+
+    for name, val in at_step[3].items():
+        scope.set(name, np.zeros_like(val))
+    assert io.load_checkpoint(exe, ckpt, main) == 3
+    for name, val in at_step[3].items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)), val, err_msg=name)
+
+    # manual rollback: the superseded manifest is archived as .prev and
+    # its generation's data files were kept — renaming it back restores
+    # the step-2 checkpoint
+    os.replace(os.path.join(ckpt, '__manifest__.json.prev'),
+               os.path.join(ckpt, '__manifest__.json'))
+    io.load_persistables(exe, ckpt, main)
+    for name, val in at_step[2].items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)), val, err_msg=name)
