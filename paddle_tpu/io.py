@@ -336,8 +336,9 @@ def _write_manifest(dirname, manifest):
         json.dump(manifest, f)
     # archive the manifest being superseded as <fname>.prev (hardlink:
     # no window with zero manifests) — together with _gc_stale_generations
-    # keeping its referenced data files, renaming it back restores the
-    # previous checkpoint.  Archived only when this write ADVANCES the
+    # keeping its referenced data files and write_step_file archiving
+    # STEP.prev, renaming the .prev files back restores the previous
+    # checkpoint.  Archived only when this write ADVANCES the
     # newest generation: a checkpoint composed of several save_vars
     # calls into one manifest (per-member saves) archives once, at the
     # first write of the new generation, so .prev is always the last
@@ -345,14 +346,7 @@ def _write_manifest(dirname, manifest):
     # .prev does not match the __manifest__*.json read glob, so loads
     # never see it.
     if os.path.exists(path) and _advances_generation(path, manifest):
-        prev = path + '.prev'
-        try:
-            if os.path.exists(prev + '.tmp'):
-                os.remove(prev + '.tmp')  # crashed earlier attempt
-            os.link(path, prev + '.tmp')
-            os.replace(prev + '.tmp', prev)
-        except OSError:
-            pass
+        _archive_prev(path)
     os.replace(tmp, path)
     if fname == _MANIFEST:
         # .p*.json AND their .prev/.tmp leftovers: a surviving archive
@@ -363,6 +357,25 @@ def _write_manifest(dirname, manifest):
                 os.remove(stale)
             except OSError:
                 pass  # a straggler's os.replace can race .tmp names away
+
+
+def _archive_prev(path):
+    """Snapshot ``path`` as ``path.prev`` — hardlink when the filesystem
+    supports it (atomic, no extra IO), tmp+rename copy otherwise (NFS/
+    FUSE mounts without link): the rollback the .prev protocol promises
+    must not silently vanish on such filesystems."""
+    prev = path + '.prev'
+    try:
+        if os.path.exists(prev + '.tmp'):
+            os.remove(prev + '.tmp')  # crashed earlier attempt
+        try:
+            os.link(path, prev + '.tmp')
+        except OSError:
+            import shutil
+            shutil.copyfile(path, prev + '.tmp')
+        os.replace(prev + '.tmp', prev)
+    except OSError:
+        pass
 
 
 def _advances_generation(path, manifest):
@@ -667,7 +680,14 @@ def step_generation(step):
 
 
 def write_step_file(dirname, step):
-    with open(os.path.join(dirname, 'STEP'), 'w') as f:
+    """Record the checkpoint's step, archiving the previous STEP as
+    STEP.prev so the .prev rollback (rename both archives back) restores
+    a CONSISTENT (params, step) pair — params alone would resume the
+    data/LR-schedule position against older weights."""
+    path = os.path.join(dirname, 'STEP')
+    if os.path.exists(path):
+        _archive_prev(path)
+    with open(path, 'w') as f:
         f.write(str(int(step)))
 
 

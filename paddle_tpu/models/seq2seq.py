@@ -84,22 +84,39 @@ def _attend_combined(dec_states, encoded, enc_proj):
     return fluid.layers.concat(input=[dec_states, context], axis=2)
 
 
-def _attend_logits(dec_states, encoded, enc_proj, dict_size):
+def _attend_hidden(dec_states, encoded, enc_proj, hidden_dim):
+    """Luong attentional hidden state (Luong'15 eq. 5): h̃ = tanh(W_c
+    [context; dec_state]).  The vocab head reads this H-wide h̃, not the
+    3H-wide concat — matching the reference book decoder whose head is
+    likewise hidden_dim-wide (test_machine_translation.py:66-69 projects
+    fc1 of size decoder_size to the vocab).  Projecting the raw concat
+    would triple the FLOPs and optimizer state of the dominant vocab
+    matmuls for the same model capacity (measured 3×377 GFLOP/step at
+    the bench config — PERF.md)."""
+    combined = _attend_combined(dec_states, encoded, enc_proj)
+    return fluid.layers.fc(
+        input=combined, size=hidden_dim, act='tanh', num_flatten_dims=2,
+        param_attr=_attr('mt_att_ht_w'), bias_attr=_attr('mt_att_ht_b'))
+
+
+def _attend_logits(dec_states, encoded, enc_proj, dict_size, hidden_dim):
     """Attention + vocab head up to the fp32 LOGITS.  Under bf16
     activations the vocab matmul runs bf16 and only what follows the
     logits is fp32."""
-    combined = _attend_combined(dec_states, encoded, enc_proj)
+    att_h = _attend_hidden(dec_states, encoded, enc_proj, hidden_dim)
     logits = fluid.layers.fc(
-        input=combined, size=dict_size, num_flatten_dims=2, act=None,
+        input=att_h, size=dict_size, num_flatten_dims=2, act=None,
         param_attr=_attr('mt_out_fc_w'), bias_attr=_attr('mt_out_fc_b'))
     if logits.dtype in ('bfloat16', 'float16'):
         logits = fluid.layers.cast(x=logits, dtype='float32')
     return logits
 
 
-def _attend_and_score(dec_states, encoded, enc_proj, dict_size):
+def _attend_and_score(dec_states, encoded, enc_proj, dict_size,
+                      hidden_dim):
     return fluid.layers.softmax(
-        x=_attend_logits(dec_states, encoded, enc_proj, dict_size))
+        x=_attend_logits(dec_states, encoded, enc_proj, dict_size,
+                         hidden_dim))
 
 
 def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32,
@@ -119,13 +136,14 @@ def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32,
         input=dec_fc, size=hidden_dim, h_0=dec_h0,
         param_attr=_attr('mt_dec_gru_w'), bias_attr=_attr('mt_dec_gru_b'))
 
-    # Luong attention: scores over padded encoder states, masked softmax
+    # Luong attention: scores over padded encoder states, masked
+    # softmax, then the eq.-5 bottleneck h̃ the vocab head reads
     enc_proj = _enc_proj(encoded, hidden_dim)
-    combined = _attend_combined(dec_out, encoded, enc_proj)
+    att_h = _attend_hidden(dec_out, encoded, enc_proj, hidden_dim)
     # prediction kept for parity consumers (fetch/inference) — when only
     # the loss is fetched XLA dead-code-eliminates this whole branch
     logits = fluid.layers.fc(
-        input=combined, size=dict_size, num_flatten_dims=2, act=None,
+        input=att_h, size=dict_size, num_flatten_dims=2, act=None,
         param_attr=_attr('mt_out_fc_w'), bias_attr=_attr('mt_out_fc_b'))
     if logits.dtype in ('bfloat16', 'float16'):
         logits = fluid.layers.cast(x=logits, dtype='float32')
@@ -136,7 +154,7 @@ def train_net(src, trg, label, dict_size, word_dim=32, hidden_dim=32,
         # head params as the fc above, so decode/inference reuse the
         # trained weights).  ops/chunked_ce.py has the analysis.
         cost = fluid.layers.fused_linear_softmax_ce(
-            input=combined, label=label, size=dict_size,
+            input=att_h, label=label, size=dict_size,
             num_flatten_dims=2, param_attr=_attr('mt_out_fc_w'),
             bias_attr=_attr('mt_out_fc_b'))
     else:
@@ -215,7 +233,8 @@ def decode(src, dict_size, word_dim=32, hidden_dim=32, beam_size=4,
         new_h = layers.reshape(new_h_flat,
                                shape=[-1, beam_size, hidden_dim])
 
-        probs = _attend_and_score(new_h, encoded, enc_proj, dict_size)
+        probs = _attend_and_score(new_h, encoded, enc_proj, dict_size,
+                                  hidden_dim)
         logp = layers.log(probs)                          # [B, K, V]
 
         sel_ids, sel_scores, parents = layers.beam_search(

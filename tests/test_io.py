@@ -516,12 +516,15 @@ def test_generation_gc_keeps_rollback(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(scope.find_var(name)), val, err_msg=name)
 
-    # manual rollback: the superseded manifest is archived as .prev and
-    # its generation's data files were kept — renaming it back restores
-    # the step-2 checkpoint
+    # manual rollback: the superseded manifest and STEP are archived as
+    # .prev and the generation's data files were kept — renaming both
+    # back restores the step-2 checkpoint as a consistent (params, step)
+    # pair
     os.replace(os.path.join(ckpt, '__manifest__.json.prev'),
                os.path.join(ckpt, '__manifest__.json'))
-    io.load_persistables(exe, ckpt, main)
+    os.replace(os.path.join(ckpt, 'STEP.prev'),
+               os.path.join(ckpt, 'STEP'))
+    assert io.load_checkpoint(exe, ckpt, main) == 2
     for name, val in at_step[2].items():
         np.testing.assert_array_equal(
             np.asarray(scope.find_var(name)), val, err_msg=name)
