@@ -687,8 +687,13 @@ def write_step_file(dirname, step):
     path = os.path.join(dirname, 'STEP')
     if os.path.exists(path):
         _archive_prev(path)
-    with open(path, 'w') as f:
+    # tmp+rename, NOT in-place: the archive may be a hardlink to the
+    # current file's inode, and an in-place truncate-and-write would
+    # update STEP.prev right along with STEP
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
         f.write(str(int(step)))
+    os.replace(tmp, path)
 
 
 def save_checkpoint(executor, dirname, main_program=None, step=None):
