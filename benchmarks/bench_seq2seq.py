@@ -11,9 +11,10 @@ def main():
 
     import os
     if on_tpu():
-        # batch 128 amortizes the per-step vocab-head Adam update
-        # (fixed ~4.5ms over 2x the tokens: +18% vs 64 — see PERF.md)
-        batch, seq, vocab, dim = 128, 64, 30000, 512
+        # batch 256: with the Luong-bottleneck head (3x fewer vocab
+        # FLOPs) and batch-tiled GRU BPTT grids, the larger batch wins
+        # (525k vs 487k tok/s at b128 — PERF.md round 4b)
+        batch, seq, vocab, dim = 256, 64, 30000, 512
     else:
         batch, seq, vocab, dim = 4, 8, 100, 32
     batch = int(os.environ.get('PADDLE_TPU_BENCH_BATCH', batch))
