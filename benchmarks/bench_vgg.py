@@ -39,7 +39,7 @@ def main():
                     np.int32)}
 
     run_bench('vgg16_train_img_per_sec', batch, build, feed,
-              steps=10 if on_tpu() else 3,
+              steps=40 if on_tpu() else 3,  # K=40: +8% vs K=10 (dispatch)
               note='batch=%d hw=%d NHWC' % (batch, hw),
               dtype='bfloat16')
 
