@@ -343,3 +343,59 @@ def test_pipeline_composes_with_dp_axis():
         np.testing.assert_allclose(
             v, np.asarray(scope.find_var(n)), rtol=1e-4, atol=1e-6,
             err_msg=n)
+
+
+def _build_mnist_conv_pipe():
+    """The recognize_digits CONV book topology (two conv-pool stages +
+    softmax head) cut at the conv-pool outputs — a 3-stage pipeline."""
+    cuts = []
+    with reset_unique_name_guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 31
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name='img', shape=[1, 14, 14],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            cp1 = fluid.nets.simple_img_conv_pool(
+                input=img, filter_size=5, num_filters=6, pool_size=2,
+                pool_stride=2, act='relu')
+            cuts.append(cp1)
+            cp2 = fluid.nets.simple_img_conv_pool(
+                input=cp1, filter_size=3, num_filters=12, pool_size=2,
+                pool_stride=2, act='relu')
+            cuts.append(cp2)
+            pred = fluid.layers.fc(input=cp2, size=10, act='softmax')
+            loss = fluid.layers.mean(
+                x=fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    return main, startup, loss, cuts
+
+
+def test_conv_book_model_pipelines():
+    """A CONV book model (recognize_digits conv, M2) trains through the
+    PipelineTranspiler: the 4-D conv/pool activations ride the flattened
+    stage interface, and per-step losses match the same Program on a
+    single device."""
+    need_devices(3)
+    rng = np.random.RandomState(5)
+    batches = [{'img': rng.randn(12, 1, 14, 14).astype('float32'),
+                'label': rng.randint(0, 10, (12, 1)).astype('int64')}
+               for _ in range(3)]
+
+    main, startup, loss, cuts = _build_mnist_conv_pipe()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    want = [float(np.ravel(exe.run(main, feed=f,
+                                   fetch_list=[loss])[0])[0])
+            for f in batches]
+
+    main, startup, loss, cuts = _build_mnist_conv_pipe()
+    pexe = fluid.Executor(fluid.CPUPlace())
+    pexe.run(startup)
+    tr = PipelineTranspiler().transpile(main, cut_vars=cuts)
+    mesh = api.make_mesh((3,), ('pp',), devices=jax.devices()[:3])
+    with api.mesh_guard(mesh):
+        got = [float(tr.run_step(pexe, feed=f, num_microbatches=4))
+               for f in batches]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
