@@ -288,18 +288,21 @@ class PipelineTranspiler(object):
             self._plan_cache[key] = plan
         fn = plan
 
+        # api._place handles the multi-host mesh (each process holds the
+        # same global value and materializes only its addressable
+        # shards — device_put cannot target non-addressable devices)
         dev = NamedSharding(mesh, P())
-        state = {n: jax.device_put(scope.get(n), dev)
+        state = {n: api._place(scope.get(n), dev)
                  for n in persist_names}
-        feeds_dev = {n: jax.device_put(v, dev) for n, v in feeds.items()}
+        feeds_dev = {n: api._place(v, dev) for n, v in feeds.items()}
         # the executor's (seed, step) PRNG chain drives stochastic ops,
         # exactly as in exe.run; the step advances per pipelined step
-        key0 = jax.device_put(exe._rng_key(self.program), dev)
+        key0 = api._place(exe._rng_key(self.program), dev)
         exe._step += 1
         loss, new_state = fn(state, feeds_dev, key0)
         for n, v in new_state.items():
             scope.set(n, v)
-        return np.asarray(loss)
+        return api._fetch_np(loss)
 
     def _build_plan(self, mesh, M, mb, feeds, persist_names,
                     dp_axis=None):
