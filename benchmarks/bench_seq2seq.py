@@ -11,10 +11,11 @@ def main():
 
     import os
     if on_tpu():
-        # batch 256: with the Luong-bottleneck head (3x fewer vocab
-        # FLOPs) and batch-tiled GRU BPTT grids, the larger batch wins
-        # (525k vs 487k tok/s at b128 — PERF.md round 4b)
-        batch, seq, vocab, dim = 256, 64, 30000, 512
+        # batch 512: with the Luong-bottleneck head (3x fewer vocab
+        # FLOPs) and batch-tiled GRU BPTT grids, larger batches keep
+        # winning (554k > 525k@b256 > 487k@b128 tok/s — PERF.md 4b);
+        # b1024 untested, diminishing returns
+        batch, seq, vocab, dim = 512, 64, 30000, 512
     else:
         batch, seq, vocab, dim = 4, 8, 100, 32
     batch = int(os.environ.get('PADDLE_TPU_BENCH_BATCH', batch))
