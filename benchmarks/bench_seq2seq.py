@@ -11,10 +11,9 @@ def main():
 
     import os
     if on_tpu():
-        # batch 512: with the Luong-bottleneck head (3x fewer vocab
-        # FLOPs) and batch-tiled GRU BPTT grids, larger batches keep
-        # winning (554k > 525k@b256 > 487k@b128 tok/s — PERF.md 4b);
-        # b1024 untested, diminishing returns
+        # batch 512 is the measured sweet spot: 554k tok/s vs
+        # 525k@b256, 487k@b128, and 464k@b1024 (activation tiles start
+        # spilling) — PERF.md round 4b
         batch, seq, vocab, dim = 512, 64, 30000, 512
     else:
         batch, seq, vocab, dim = 4, 8, 100, 32
