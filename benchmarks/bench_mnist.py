@@ -24,11 +24,11 @@ def main():
                     np.float32),
                 'label': rng.integers(0, 10, (batch, 1)).astype(np.int32)}
 
-    # K=200: the ~3 ms device step is dispatch-bound at the default
-    # K=20 over the tunneled chip (RTT/K ≈ 5.5 ms/step); 200 chained
-    # steps measured 4.0x the K=20 number
+    # K=500: the ~1.6 ms device step is dispatch-bound at short chains
+    # over the tunneled chip (K=20 measured 315k ex/s, K=200 1.26M,
+    # K=500 1.42M; b4096 regresses to 930k)
     run_bench('mnist_conv_examples_per_sec', batch, build, feed,
-              steps=200, note='batch=%d' % batch)
+              steps=500, note='batch=%d' % batch)
 
 
 if __name__ == '__main__':
