@@ -28,7 +28,8 @@ def main():
     # over the tunneled chip (K=20 measured 315k ex/s, K=200 1.26M,
     # K=500 1.42M; b4096 regresses to 930k)
     run_bench('mnist_conv_examples_per_sec', batch, build, feed,
-              steps=500, note='batch=%d' % batch)
+              steps=500 if on_tpu() else 5,
+              note='batch=%d' % batch)
 
 
 if __name__ == '__main__':
