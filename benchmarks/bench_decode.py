@@ -1,0 +1,66 @@
+"""seq2seq beam-search GENERATION throughput — the inference-side
+counterpart of bench_seq2seq (reference book decode path: While-loop
+beam lattice, layers.beam_search / beam_search_decode).
+
+The decode program is one XLA While computation (the beam loop lowers
+to a lax.scan), so a whole [B, K]-beam generation is a single
+dispatch; per-call wall includes that dispatch."""
+import time
+
+import numpy as np
+
+from common import on_tpu
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import seq2seq
+
+    if on_tpu():
+        batch, seq, vocab, dim, beam, max_len = 64, 64, 30000, 512, 4, 32
+        reps = 20
+    else:
+        batch, seq, vocab, dim, beam, max_len = 4, 8, 100, 32, 2, 5
+        reps = 2
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        src = fluid.layers.data(name='src_word_id', shape=[1],
+                                dtype='int64', lod_level=1)
+        ids, scores = seq2seq.decode(
+            src, vocab, word_dim=dim // 2, hidden_dim=dim,
+            beam_size=beam, max_len=max_len)
+    place = fluid.TPUPlace(0) if on_tpu() else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    ln = np.full((batch,), seq, np.int32)
+    feed = {'src_word_id': (rng.integers(
+        1, vocab, (batch, seq, 1)).astype(np.int32), ln)}
+
+    out = exe.run(main_p, feed=feed, fetch_list=[ids, scores],
+                  return_numpy=False)  # compile + warm
+    np.asarray(out[0])
+
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = exe.run(main_p, feed=feed, fetch_list=[ids, scores],
+                          return_numpy=False)
+        np.asarray(out[0])
+        dt = time.perf_counter() - t0
+        # generated tokens: every step extends B x K live hypotheses
+        samples.append(batch * beam * max_len * reps / dt)
+    import json
+    print(json.dumps({
+        'metric': 'seq2seq_beam_decode_tokens_per_sec',
+        'value': round(float(np.median(samples)), 2),
+        'samples': [round(s, 1) for s in samples],
+        'note': 'batch=%d beam=%d max_len=%d vocab=%d dim=%d'
+                % (batch, beam, max_len, vocab, dim)}))
+
+
+if __name__ == '__main__':
+    main()
