@@ -26,8 +26,11 @@ def main():
 
     tpu = common.on_tpu()
     if tpu:
-        # B=16 fills the chip: 1.94M tok/s / 57 TFLOPS vs
-        # 1.78M@B8 and 1.02M/30T@B2 (head-batch starvation)
+        # B=16 fills the chip.  r5: 1.53M tok/s / 45 TFLOPS honest
+        # fwd+bwd (the r1-r4 ~57 TFLOPS lines had the dkv kernel
+        # DCE'd away — see the step() comment); per-phase roofline in
+        # PERF.md says this is ~50% of the chip's MEASURED 101.6
+        # TFLOPS square-matmul peak, the D=64 shape ceiling
         B, T, H, D = 16, 8192, 8, 64
         steps, warmup = 10, 2
     else:
@@ -44,20 +47,25 @@ def main():
         return jnp.sum(flash_attention(q, k, v, causal=True)
                        .astype(jnp.float32))
 
-    # chain q <- q - eps*dq so each step depends on the previous one:
-    # the device serializes the chain and ONE final sync times all steps
-    # (a per-step host sync would measure the tunnel RTT instead)
+    # chain (q, k, v) <- sgd(step) so each step depends on the previous
+    # one: the device serializes the chain and ONE final sync times all
+    # steps (a per-step host sync would measure the tunnel RTT instead).
+    # ALL THREE grads must feed the chain: consuming only dq lets XLA
+    # dead-code-eliminate the dkv backward kernel outright (the r1-r4
+    # lines did exactly that — they timed fwd+dq, not fwd+bwd).
     @jax.jit
     def step(q, k, v):
         dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return (q - 1e-3 * dq).astype(q.dtype)
+        return ((q - 1e-3 * dq).astype(q.dtype),
+                (k - 1e-3 * dk).astype(k.dtype),
+                (v - 1e-3 * dv).astype(v.dtype))
 
-    qq = step(q, k, v)
+    qq, kk, vv = step(q, k, v)
     np.asarray(qq[0, 0, 0])  # sync
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        qq = step(qq, k, v)
+        qq, kk, vv = step(qq, kk, vv)
     np.asarray(qq[0, 0, 0])  # sync the whole chain
     dt_s = (time.perf_counter() - t0) / steps
 

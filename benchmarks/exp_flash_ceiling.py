@@ -1,0 +1,149 @@
+"""Flash fwd limiter bisection: stripped kernel variants at the bench
+shape isolate what each VPU stage costs on top of the two MXU matmuls.
+
+variants:
+  mm      — s = q@k; acc += s@v           (MXU + DMA only)
+  exp     — s = q@k; acc += exp(s)@v      (+ exp)
+  maxexp  — s = q@k; acc += exp(s-max)@v  (+ cross-lane max)
+  full    — the real _fa_kernel softmax tail (reference point)
+
+Same grid/causal dead-tile structure as the production kernel, so the
+deltas attribute time to individual VPU stages.
+"""
+import argparse
+import functools
+import json
+import time
+
+import numpy as np
+
+import common  # noqa: F401
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--B', type=int, default=16)
+    ap.add_argument('--T', type=int, default=8192)
+    ap.add_argument('--H', type=int, default=8)
+    ap.add_argument('--D', type=int, default=64)
+    ap.add_argument('--bq', type=int, default=1024)
+    ap.add_argument('--bk', type=int, default=1024)
+    ap.add_argument('--steps', type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tpu = common.on_tpu()
+    B, T, H, D = args.B, args.T, args.H, args.D
+    bq, bk = args.bq, args.bk
+    BH = B * H
+    assert T % bq == 0 and T % bk == 0, \
+        "T must be a block multiple (grid would silently truncate)"
+    nq, nk = T // bq, T // bk
+    interp = not tpu
+
+    def make_kernel(variant):
+        kt = variant.endswith('T')
+
+        def kern(q_ref, k_ref, v_ref, o_ref, acc_scr):
+            ki = pl.program_id(2)
+            qi = pl.program_id(1)
+
+            @pl.when(ki == 0)
+            def _init():
+                acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+            alive = (qi * bq + bq - 1) >= (ki * bk)
+
+            @pl.when(alive)
+            def _compute():
+                q = q_ref[0]
+                k = k_ref[0]
+                v = v_ref[0]
+                if kt:  # k block arrives [D, bk]: plain NN matmul
+                    s = jax.lax.dot_general(
+                        q, k, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                else:   # k block [bk, D]: contraction on both lane dims
+                    s = jax.lax.dot_general(
+                        q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                if variant.startswith('mm'):
+                    p = s
+                elif variant == 'exp':
+                    p = jnp.exp(s)
+                else:  # maxexp
+                    p = jnp.exp(s - jnp.max(s, axis=1)[:, None])
+                acc_scr[...] += jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            @pl.when(ki == nk - 1)
+            def _fin():
+                o_ref[0] = acc_scr[...].astype(o_ref.dtype)
+        return kern
+
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if tpu else jnp.float32
+    q = jnp.asarray(rng.normal(size=(BH, T, D)) * 0.1, dt)
+    k = jnp.asarray(rng.normal(size=(BH, T, D)) * 0.1, dt)
+    v = jnp.asarray(rng.normal(size=(BH, T, D)), dt)
+
+    alive = sum(1 for qi in range(nq) for ki in range(nk)
+                if (qi * bq + bq - 1) >= ki * bk)
+    executed = 4 * T * T * D * BH * (alive / (nq * nk))
+
+    kT = jnp.swapaxes(k, 1, 2)  # [BH, D, T] for the NN-form variant
+
+    out = {}
+    for variant in ['mm', 'mmT', 'exp', 'maxexp']:
+        kspec = (pl.BlockSpec((1, D, bk), lambda b, i, j: (b, 0, j))
+                 if variant.endswith('T')
+                 else pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)))
+        run = pl.pallas_call(
+            make_kernel(variant),
+            grid=(BH, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+                kspec,
+                pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((BH, T, D), dt),
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=('parallel', 'parallel', 'arbitrary'),
+                vmem_limit_bytes=64 * 1024 * 1024),
+            interpret=interp,
+        )
+
+        karg = kT if variant.endswith('T') else k
+
+        @jax.jit
+        def chain(q, k, v, run=run):
+            def body(c, _):
+                o = run(c, k, v)
+                return (c - 1e-6 * o).astype(c.dtype), None
+            qf, _ = jax.lax.scan(body, q, None, length=args.steps)
+            return qf
+
+        cur = chain(q, karg, v)
+        np.asarray(cur[0, 0])
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cur = chain(q, karg, v)
+            np.asarray(cur[0, 0])
+            ts.append((time.perf_counter() - t0) / args.steps)
+        dt_s = float(np.median(ts))
+        out[variant] = {'ms': round(dt_s * 1e3, 3),
+                        'executed_tflops': round(executed / dt_s / 1e12, 2)}
+
+    print(json.dumps(out))
+
+
+if __name__ == '__main__':
+    main()

@@ -20,6 +20,7 @@ On non-TPU backends the forward kernel runs with interpret=True, so the
 same code path is exercised by CPU CI.
 """
 import functools
+import math
 import os
 
 import numpy as _np
@@ -44,8 +45,14 @@ def _tile_alive(qoff, koff, qi, ki, block_q, block_k):
     return (qoff + qi * block_q + block_q - 1) >= (koff + ki * block_k)
 
 
+def _tile_interior(qoff, koff, qi, ki, block_q, block_k):
+    """Causal all-valid predicate: every (q, k) pair in the tile is
+    unmasked when the tile's oldest query is >= its newest key."""
+    return (qoff + qi * block_q) >= (koff + ki * block_k + block_k - 1)
+
+
 def _fa_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-               m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+               m_scr, l_scr, acc_scr, *, causal, block_q, block_k,
                nk, tk):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
@@ -65,48 +72,95 @@ def _fa_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(alive)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        # matmul inputs stay in the storage dtype (bf16 on the bench
+        # path): the MXU multiplies bf16 at full rate and accumulates
+        # fp32 via preferred_element_type — casting to fp32 first would
+        # run the matmul at a fraction of peak.  Softmax state (m, l,
+        # acc) is fp32 throughout.  q arrives pre-scaled (see
+        # _fa_forward), so no per-element scale multiply here.
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]  # [bk, d]
+        d = v.shape[-1]
+        # l-sum rides the PV matmul when head_dim leaves idle lanes:
+        # augmenting v with a ones column turns sum(p, axis=1) — a
+        # 1M-element cross-lane VPU reduce per 1024^2 tile — into lane
+        # d of the matmul output the MXU was padding to 128 anyway
+        mxu_lsum = d % 128 != 0
+        if mxu_lsum:
+            dx = -(-(d + 1) // 128) * 128 - d  # lanes to fill
+            v = jnp.concatenate(
+                [v, jnp.full((v.shape[0], 1), 1, v.dtype),
+                 jnp.zeros((v.shape[0], dx - 1), v.dtype)], axis=1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        kpos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = kpos < tk  # last block may be padding past the real length
-        if causal:
-            # global positions: scalar-prefetched offsets shift the local
-            # indices, so causal masking works across ring-rotated K blocks
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = valid & ((qoff_ref[0] + qpos) >= (koff_ref[0] + kpos))
-        s = jnp.where(valid, s, _NEG_INF)
+                                preferred_element_type=jnp.float32)
 
-        m_prev = m_scr[:, 0]  # [bq]
-        l_prev = l_scr[:, 0]
-        m_cur = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        # explicit zero for masked entries: when a whole row is masked,
-        # s == m_new == _NEG_INF and bare exp(s - m_new) would be 1
-        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1)
-        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        def _tail(s, valid):
+            m_prev = m_scr[:, 0]  # [bq]
+            l_prev = l_scr[:, 0]
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            if valid is not None:
+                # explicit zero for masked entries: when a whole row is
+                # masked, s == m_new == _NEG_INF and exp(0) would be 1
+                p = jnp.where(valid, p, 0.0)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if mxu_lsum:
+                l_new = l_prev * alpha + pv[:, d]
+            else:
+                l_new = l_prev * alpha + jnp.sum(p, axis=1)
+            acc_scr[...] = acc_scr[...] * alpha[:, None] + pv[:, :d]
+            m_scr[...] = m_new[:, None]
+            l_scr[...] = l_new[:, None]
+
+        def _masked_tail():
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = kpos < tk  # last block may pad past the real length
+            if causal:
+                # global positions: scalar-prefetched offsets shift the
+                # local indices, so causal masking works across
+                # ring-rotated K blocks
+                qpos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                valid = valid & ((qoff_ref[0] + qpos) >=
+                                 (koff_ref[0] + kpos))
+            _tail(jnp.where(valid, s, _NEG_INF), valid)
+
+        # interior fast path: tiles with no padding columns and (if
+        # causal) strictly below the diagonal band skip the iota/
+        # compare/where masking ops entirely — at bq=bk=1024 that is
+        # ~5 of the ~15 VPU ops per element on the T=8192 bench, and
+        # interior tiles are the vast majority of alive tiles
+        no_pad = True if tk % block_k == 0 else (ki + 1) * block_k <= tk
+        if causal:
+            interior = _tile_interior(qoff_ref[0], koff_ref[0], qi, ki,
+                                      block_q, block_k)
+            if no_pad is not True:
+                interior = jnp.logical_and(interior, no_pad)
+            pl.when(interior)(lambda: _tail(s, None))
+            pl.when(jnp.logical_not(interior))(_masked_tail)
+        elif tk % block_k == 0:
+            _tail(s, None)
+        else:
+            pl.when(no_pad)(lambda: _tail(s, None))
+            pl.when(jnp.logical_not(no_pad))(_masked_tail)
 
     @pl.when(ki == nk - 1)
     def _finish():
         l = l_scr[:, 0]
         l_safe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        # lse broadcast across the 128-lane axis (Mosaic wants the last
-        # two block dims (block_q, 128); column 0 is read back outside)
+        # lse as a [bq, 1] sublane vector (the same layout the backward
+        # reads it in): 4 KB per q-block instead of the 512 KB a
+        # 128-lane broadcast would write — over half a GB per step saved
+        # at the T=8192 bench shape
         lse = m_scr[:, 0] + jnp.log(l_safe)
-        lse_ref[0] = jnp.broadcast_to(lse[:, None],
-                                      lse_ref.shape[1:]).astype(
-                                          lse_ref.dtype)
+        lse_ref[0, 0] = lse[:, None].astype(lse_ref.dtype)
 
 
 def _sds(shape, dtype):
@@ -123,6 +177,18 @@ def _sds(shape, dtype):
     except Exception:
         pass
     return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _dimsem(*sems):
+    """Grid dimension semantics: the two outer dims (batch*heads and the
+    non-accumulated block axis) are parallel, the innermost accumulation
+    axis is arbitrary/sequential — lets Mosaic pipeline DMA + MXU + VPU
+    across grid steps instead of treating the whole grid as a chain.
+    The scoped-vmem limit is raised from the 16 MB default: the
+    interior/masked two-branch tails hold two [bq, bk] fp32 tiles live
+    (~18.4 MB at 1024x1024), and v5e has 128 MB of VMEM to spend."""
+    return pltpu.CompilerParams(dimension_semantics=sems,
+                                vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret,
@@ -146,7 +212,11 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     if tk_p != tk:
         k = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0)))
-    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+    # fold the softmax scale into q once ([BH, T, D] pass) instead of
+    # multiplying every [bq, bk] score tile in-kernel (T/bk times more
+    # elements); backward folds it symmetrically (see _fa_backward_pallas)
+    q = (q * scale).astype(q.dtype)
+    kernel = functools.partial(_fa_kernel, causal=causal,
                                block_q=block_q, block_k=block_k, nk=nk,
                                tk=tk)
     qoff = jnp.asarray([0 if q_offset is None else q_offset], jnp.int32)
@@ -161,12 +231,14 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128),
-                         lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, i, j, *_: (b, i, 0, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            # m/l as [bq, 1] sublane vectors: a 128-lane scratch would
+            # broadcast-write 512 KB per k-iteration for 4 KB of state
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
     )
@@ -175,8 +247,9 @@ def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         grid_spec=grid_spec,
         out_shape=[
             _sds((bh, tq_p, d), q.dtype),
-            _sds((bh, tq_p, 128), jnp.float32),
+            _sds((bh, nq, block_q, 1), jnp.float32),
         ],
+        compiler_params=_dimsem('parallel', 'parallel', 'arbitrary'),
         interpret=interpret,
     )(qoff, koff, q, k, v)
 
@@ -186,7 +259,8 @@ def _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
     tq = q.shape[1]
     o, lse = _fa_forward(q, k, v, causal, scale, block_q, block_k,
                          interpret, q_offset, k_offset)
-    return o[:, :tq], lse[:, :tq, 0]
+    bh = lse.shape[0]
+    return o[:, :tq], lse.reshape(bh, -1)[:, :tq]
 
 
 def _dense_ref(q, k, v, causal, scale):
@@ -251,32 +325,43 @@ def _fa_backward(causal, scale, block_k, res, do, dlse=None):
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
-def _bwd_common(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, *, scale,
-                causal, q0, k0, tq, tk, qoff, koff, bq, bk):
-    """Shared per-tile flash backward math: returns\n    (q, do, k, p, ds) with p/ds [bq, bk] fp32."""
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+def _bwd_common(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, *,
+                causal, q0, k0, qoff, koff, bq, bk, masked):
+    """Shared per-tile flash backward math: returns
+    (q, do, k, p, ds) with q/do/k in storage dtype (bf16 matmul inputs
+    at full MXU rate, fp32 accumulate) and p/ds [bq, bk] fp32.
+
+    q arrives pre-scaled (s and hence p/lse agree with the forward);
+    ds therefore carries no scale factor — dk = ds^T q_scaled is exact,
+    and the dq kernel multiplies its accumulator by scale once at
+    flush.  Padding needs no mask here: padded q/do/lse/di rows are
+    zeros (p row = 1 but do/di = 0 ⇒ dv/ds contributions vanish),
+    padded k rows zero out dq contributions, and padded dk/dv rows are
+    sliced off by the caller — so `masked` (a static flag; the caller
+    branches on the tile predicate) is only True on causal
+    diagonal-band tiles."""
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0]  # [bq, 1] sublane vector
     di = di_ref[0, 0]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    valid = (qpos < tq) & (kpos < tk)  # block padding rows/cols
-    if causal:
-        valid = valid & ((qoff + qpos) >= (koff + kpos))
-    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+                            preferred_element_type=jnp.float32)
+    p = jnp.exp(s - lse)
+    if masked:
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        p = jnp.where((qoff + qpos) >= (koff + kpos), p, 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - di) * scale
+    ds = p * (dp - di)
     return q, do, k, p, ds
 
 
 def _fa_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
                        k_ref, v_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                       scale, causal, block_q, block_k, nq, tq, tk):
+                       causal, block_q, block_k, nq):
     ki = pl.program_id(1)
     qi = pl.program_id(2)  # innermost: accumulate over q blocks
 
@@ -292,18 +377,30 @@ def _fa_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
         alive = _tile_alive(qoff_ref[0], koff_ref[0], qi, ki,
                             block_q, block_k)
 
-    @pl.when(alive)
-    def _compute():
+    def _go(masked):
         q, do, k, p, ds = _bwd_common(
-            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, scale=scale,
-            causal=causal, q0=qi * block_q, k0=ki * block_k, tq=tq, tk=tk,
-            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k)
+            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+            causal=causal, q0=qi * block_q, k0=ki * block_k,
+            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k,
+            masked=masked)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        # interior tiles (strictly below the diagonal band) skip the
+        # iota/compare/where masking ops — see _bwd_common for why
+        # padding never needs a mask in the backward
+        interior = _tile_interior(qoff_ref[0], koff_ref[0], qi, ki,
+                                  block_q, block_k)
+        pl.when(interior)(lambda: _go(False))
+        pl.when(jnp.logical_and(alive, jnp.logical_not(interior)))(
+            lambda: _go(True))
+    else:
+        _go(False)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -313,7 +410,7 @@ def _fa_bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
 
 def _fa_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
                       k_ref, v_ref, dq_ref, dq_scr, *, scale, causal,
-                      block_q, block_k, nk, tq, tk):
+                      block_q, block_k, nk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)  # innermost: accumulate over k blocks
 
@@ -326,146 +423,323 @@ def _fa_bwd_dq_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref, di_ref,
         alive = _tile_alive(qoff_ref[0], koff_ref[0], qi, ki,
                             block_q, block_k)
 
-    @pl.when(alive)
-    def _compute():
+    def _go(masked):
         _q, _do, k, p, ds = _bwd_common(
-            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, scale=scale,
-            causal=causal, q0=qi * block_q, k0=ki * block_k, tq=tq, tk=tk,
-            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k)
+            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+            causal=causal, q0=qi * block_q, k0=ki * block_k,
+            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k,
+            masked=masked)
         dq_scr[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        interior = _tile_interior(qoff_ref[0], koff_ref[0], qi, ki,
+                                  block_q, block_k)
+        pl.when(interior)(lambda: _go(False))
+        pl.when(jnp.logical_and(alive, jnp.logical_not(interior)))(
+            lambda: _go(True))
+    else:
+        _go(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+        # ds carried no scale in-kernel (q was pre-scaled); fold the
+        # d(scale*qk)/dq chain factor in once per accumulator flush
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _fa_backward_pallas(causal, scale, block_q, block_k, res, do,
-                        dlse, interpret):
-    """Pallas flash backward: dk/dv kernel (grid bh, nk, nq) and dq
-    kernel (grid bh, nq, nk), both recomputing p from the saved lse in
-    VMEM — the [Tq, Tk] lattice never touches HBM (the jax-scan fallback
-    `_fa_backward` streams [Tq, block_k] slabs through HBM instead)."""
+def _fa_bwd_fused_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref,
+                         di_ref, k_ref, v_ref, dk_ref, dv_ref, dq_ref,
+                         dk_scr, dv_scr, dq_acc, *, scale, causal,
+                         block_q, block_k, nq, nk):
+    """One k-major pass computing dk, dv AND dq: recomputes s/dp once
+    per tile instead of once in each of the split kernels — 5 matmuls
+    per tile instead of 7 (the split pair's s+dp are exactly the two
+    redundant ones).  dq accumulates in a persistent [tq_p, d] fp32
+    VMEM scratch across the outer k loop (callers gate the fused path
+    on that scratch fitting VMEM; long-T falls back to the split
+    kernels).  Grid (bh, nk, nq): k blocks outer, q blocks inner."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    qs = pl.dslice(qi * block_q, block_q)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    @pl.when(ki == 0)
+    def _init_dq():
+        # unconditional (outside the alive gate): with ring offsets a
+        # q block can have no alive k tile at all and must still flush
+        # zeros
+        dq_acc[qs, :] = jnp.zeros((block_q, dq_acc.shape[-1]),
+                                  jnp.float32)
+
+    alive = True
+    if causal:
+        alive = _tile_alive(qoff_ref[0], koff_ref[0], qi, ki,
+                            block_q, block_k)
+
+    def _go(masked):
+        q, do, k, p, ds = _bwd_common(
+            q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+            causal=causal, q0=qi * block_q, k0=ki * block_k,
+            qoff=qoff_ref[0], koff=koff_ref[0], bq=block_q, bk=block_k,
+            masked=masked)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dsl = ds.astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            dsl, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_acc[qs, :] += jax.lax.dot_general(
+            dsl, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        interior = _tile_interior(qoff_ref[0], koff_ref[0], qi, ki,
+                                  block_q, block_k)
+        pl.when(interior)(lambda: _go(False))
+        pl.when(jnp.logical_and(alive, jnp.logical_not(interior)))(
+            lambda: _go(True))
+    else:
+        _go(False)
+
+    @pl.when(qi == nq - 1)
+    def _finish_kv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    @pl.when(ki == nk - 1)
+    def _finish_dq():
+        # ds carried no scale in-kernel (q was pre-scaled): fold the
+        # chain factor in at the single flush
+        dq_ref[0, qs, :] = (dq_acc[qs, :] * scale).astype(dq_ref.dtype)
+
+
+# cap on the fused backward's persistent dq accumulator (fp32 [tq_p, d]
+# VMEM scratch); longer sequences fall back to the split kernels
+_FUSED_DQ_BYTES = 16 * 1024 * 1024
+
+
+def _fa_backward_pallas(causal, scale, tiles, res, do,
+                        dlse, interpret, phases=('dkv', 'dq'),
+                        allow_fused=True):
+    """Pallas flash backward.  Default is ONE fused k-major kernel
+    (grid bh, nk, nq) producing dk, dv and dq with a single s/dp
+    recompute per tile — 5 matmuls instead of the split pair's 7.  The
+    split kernels (dk/dv: grid (bh, nk, nq); dq: grid (bh, nq, nk))
+    remain for long sequences whose [tq, d] dq accumulator would not
+    fit VMEM, for per-phase perf runs, and for the
+    PADDLE_TPU_FLASH_BWD_SPLIT A/B gate.  All recompute p from the
+    saved lse in VMEM — the [Tq, Tk] lattice never touches HBM (the
+    jax-scan fallback `_fa_backward` streams [Tq, block_k] slabs
+    through HBM instead).
+    `tiles` = ((bq_dkv, bk_dkv), (bq_dq, bk_dq)): the two split
+    kernels have different best tiles on v5e (dkv likes wide k blocks —
+    its accumulators live on the k axis); the fused kernel uses the
+    dkv pair.  `phases` lets the perf harness time each split kernel
+    alone (skipped grads come back as None)."""
     q, k, v, q_off, k_off, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
-    bq = min(block_q, tq)
-    bk = min(block_k, tk)
-    nq = pl.cdiv(tq, bq)
-    nk = pl.cdiv(tk, bk)
-    tq_p, tk_p = nq * bq, nk * bk
+    (bq1, bk1), (bq2, bk2) = tiles
+    bq1, bq2 = min(bq1, tq), min(bq2, tq)
+    bk1, bk2 = min(bk1, tk), min(bk2, tk)
+    # one shared padding serves both kernels: pad to the lcm of the two
+    # block sizes on each axis (tiles are powers of two in practice)
+    tq_p = pl.cdiv(tq, math.lcm(bq1, bq2)) * math.lcm(bq1, bq2)
+    tk_p = pl.cdiv(tk, math.lcm(bk1, bk2)) * math.lcm(bk1, bk2)
 
     dof = do.astype(jnp.float32)
     di = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [BH, Tq]
     if dlse is not None:
         di = di - dlse.astype(jnp.float32)
 
-    qp = jnp.pad(q, ((0, 0), (0, tq_p - tq), (0, 0)))
+    # pre-scale q (one [BH, T, D] pass) so the kernels never touch the
+    # [bq, bk] score tiles with a scale multiply; dq re-applies scale at
+    # its accumulator flush (see _fa_bwd_dq_kernel._finish)
+    qp = jnp.pad((q * scale).astype(q.dtype),
+                 ((0, 0), (0, tq_p - tq), (0, 0)))
     dop = jnp.pad(do, ((0, 0), (0, tq_p - tq), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, tk_p - tk), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, tk_p - tk), (0, 0)))
     # lse/di ride as [BH, nq, bq, 1] sublane-vector blocks: 512B per
     # tile visit instead of the 64KB a 128-lane broadcast would re-read
-    lse_b = jnp.pad(lse, ((0, 0), (0, tq_p - tq))).reshape(
-        bh, nq, bq, 1)
-    di_b = jnp.pad(di, ((0, 0), (0, tq_p - tq))).reshape(bh, nq, bq, 1)
+    lse_p = jnp.pad(lse, ((0, 0), (0, tq_p - tq)))
+    di_p = jnp.pad(di, ((0, 0), (0, tq_p - tq)))
 
     qoff = jnp.asarray([0 if q_off is None else q_off], jnp.int32)
     koff = jnp.asarray([0 if k_off is None else k_off], jnp.int32)
 
-    dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, j, i, *_: (b, i, 0, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, j, i, *_: (b, i, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
-        ],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-    )
-    dk, dv = pl.pallas_call(
-        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, nq=nq, tq=tq, tk=tk),
-        grid_spec=dkv_spec,
-        out_shape=[_sds((bh, tk_p, d), k.dtype),
-                   _sds((bh, tk_p, d), v.dtype)],
-        interpret=interpret,
-    )(qoff, koff, qp, dop, lse_b, di_b, kp, vp)
+    dk = dv = dq = None
+    if (allow_fused and 'dkv' in phases and 'dq' in phases
+            and tq_p * d * 4 <= _FUSED_DQ_BYTES):
+        bq, bk = bq1, bk1
+        nq, nk = tq_p // bq, tk_p // bk
+        fused_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda b, j, i, *_: (b, i, 0, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda b, j, i, *_: (b, i, 0, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+                # dq rides one whole-[tq_p, d] block per bh, flushed
+                # from the persistent accumulator at the last k block
+                pl.BlockSpec((1, tq_p, d), lambda b, j, i, *_: (b, 0, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((tq_p, d), jnp.float32)],
+        )
+        dk, dv, dq = pl.pallas_call(
+            functools.partial(_fa_bwd_fused_kernel, scale=scale,
+                              causal=causal, block_q=bq, block_k=bk,
+                              nq=nq, nk=nk),
+            grid_spec=fused_spec,
+            out_shape=[_sds((bh, tk_p, d), k.dtype),
+                       _sds((bh, tk_p, d), v.dtype),
+                       _sds((bh, tq_p, d), q.dtype)],
+            # the k axis carries the dq accumulation -> arbitrary
+            compiler_params=_dimsem('parallel', 'arbitrary', 'arbitrary'),
+            interpret=interpret,
+        )(qoff, koff, qp, dop,
+          lse_p.reshape(bh, nq, bq, 1), di_p.reshape(bh, nq, bq, 1),
+          kp, vp)
+        return dq[:, :tq], dk[:, :tk], dv[:, :tk]
 
-    dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, i, j, *_: (b, i, 0, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, i, j, *_: (b, i, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
-        ],
-        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0))],
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-    )
-    dq, = pl.pallas_call(
-        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, nk=nk, tq=tq, tk=tk),
-        grid_spec=dq_spec,
-        out_shape=[_sds((bh, tq_p, d), q.dtype)],
-        interpret=interpret,
-    )(qoff, koff, qp, dop, lse_b, di_b, kp, vp)
+    if 'dkv' in phases:
+        bq, bk = bq1, bk1
+        nq, nk = tq_p // bq, tk_p // bk
+        dkv_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, bq, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda b, j, i, *_: (b, i, 0, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda b, j, i, *_: (b, i, 0, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i, *_: (b, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)],
+        )
+        dk, dv = pl.pallas_call(
+            functools.partial(_fa_bwd_dkv_kernel,
+                              causal=causal, block_q=bq, block_k=bk,
+                              nq=nq),
+            grid_spec=dkv_spec,
+            out_shape=[_sds((bh, tk_p, d), k.dtype),
+                       _sds((bh, tk_p, d), v.dtype)],
+            compiler_params=_dimsem('parallel', 'parallel', 'arbitrary'),
+            interpret=interpret,
+        )(qoff, koff, qp, dop,
+          lse_p.reshape(bh, nq, bq, 1), di_p.reshape(bh, nq, bq, 1),
+          kp, vp)
 
-    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+    if 'dq' in phases:
+        bq, bk = bq2, bk2
+        nq, nk = tq_p // bq, tk_p // bk
+        dq_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda b, i, j, *_: (b, i, 0, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda b, i, j, *_: (b, i, 0, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
+            ],
+            out_specs=[pl.BlockSpec((1, bq, d),
+                                    lambda b, i, j, *_: (b, i, 0))],
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        )
+        dq, = pl.pallas_call(
+            functools.partial(_fa_bwd_dq_kernel, scale=scale,
+                              causal=causal, block_q=bq, block_k=bk,
+                              nk=nk),
+            grid_spec=dq_spec,
+            out_shape=[_sds((bh, tq_p, d), q.dtype)],
+            compiler_params=_dimsem('parallel', 'parallel', 'arbitrary'),
+            interpret=interpret,
+        )(qoff, koff, qp, dop,
+          lse_p.reshape(bh, nq, bq, 1), di_p.reshape(bh, nq, bq, 1),
+          kp, vp)
+
+    return (None if dq is None else dq[:, :tq],
+            None if dk is None else dk[:, :tk],
+            None if dv is None else dv[:, :tk])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash_with_lse(q, k, v, q_off, k_off, causal, scale, block_q,
-                    block_k, interpret, bwd_mode):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_with_lse(q, k, v, q_off, k_off, causal, scale, tiles,
+                    interpret, bwd_mode):
     """[BH, T, D] kernel entry returning (o, lse); differentiable —
     the backward folds both cotangents into one flash recompute.
     q_off/k_off are traced int32 scalars shifting the causal mask.
+    tiles = ((bq, bk) for fwd, dkv, dq) — static, per-phase.
     bwd_mode ('pallas'|'scan') is part of the vjp cache key, so the env
     gates that select it (resolved by the caller) take effect on the
     next call instead of silently needing jax.clear_caches()."""
-    return _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
-                              interpret, q_off, k_off)
+    return _fa_forward_sliced(q, k, v, causal, scale, tiles[0][0],
+                              tiles[0][1], interpret, q_off, k_off)
 
 
-def _flash_fwd(q, k, v, q_off, k_off, causal, scale, block_q, block_k,
+def _flash_fwd(q, k, v, q_off, k_off, causal, scale, tiles,
                interpret, bwd_mode):
-    o, lse = _fa_forward_sliced(q, k, v, causal, scale, block_q, block_k,
-                                interpret, q_off, k_off)
+    o, lse = _fa_forward_sliced(q, k, v, causal, scale, tiles[0][0],
+                                tiles[0][1], interpret, q_off, k_off)
     return (o, lse), (q, k, v, q_off, k_off, o, lse)
 
 
 def _bwd_mode_from_env(interpret):
     """PADDLE_TPU_FLASH_BWD_SCAN forces the jax-scan path on TPU (A/B
     numerics); PADDLE_TPU_FLASH_BWD_PALLAS forces the Pallas kernels
-    (interpret mode) off-TPU."""
+    (interpret mode) off-TPU; PADDLE_TPU_FLASH_BWD_SPLIT forces the
+    split dkv/dq kernel pair instead of the fused k-major kernel."""
     if _env_on('PADDLE_TPU_FLASH_BWD_PALLAS'):
-        return 'pallas'
+        return ('pallas_split' if _env_on('PADDLE_TPU_FLASH_BWD_SPLIT')
+                else 'pallas')
     if interpret or _env_on('PADDLE_TPU_FLASH_BWD_SCAN'):
         return 'scan'
+    if _env_on('PADDLE_TPU_FLASH_BWD_SPLIT'):
+        return 'pallas_split'
     return 'pallas'
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, bwd_mode,
+def _flash_bwd(causal, scale, tiles, interpret, bwd_mode,
                res, cts):
     do, dlse = cts
-    if bwd_mode == 'pallas':
-        dq, dk, dv = _fa_backward_pallas(causal, scale, block_q, block_k,
-                                         res, do, dlse,
-                                         interpret=interpret)
+    if bwd_mode in ('pallas', 'pallas_split'):
+        dq, dk, dv = _fa_backward_pallas(
+            causal, scale, tiles[1:], res, do, dlse,
+            interpret=interpret,
+            allow_fused=(bwd_mode == 'pallas'))
     else:  # CPU: the jax-scan recompute (fast under interpret-free jit)
-        dq, dk, dv = _fa_backward(causal, scale, block_k, res, do, dlse)
+        dq, dk, dv = _fa_backward(causal, scale, tiles[1][1], res, do,
+                                  dlse)
     f0 = _np.zeros((), jax.dtypes.float0)  # int operands: zero cotangent
     return dq, dk, dv, f0, f0
 
@@ -496,18 +770,28 @@ def attention_with_lse(q, k, v, causal=False, scale=None, block_q=None,
     masking across ring-rotated K/V shards."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    # head-dim-aware default tiles: d<=64 leaves VMEM headroom for 1024
-    # (measured ~1.2x over 512 on v5e fwd+bwd); d=128 regresses there
-    auto = 1024 if q.shape[-1] <= 64 else 512
-    block_q = auto if block_q is None else block_q
-    block_k = auto if block_k is None else block_k
+    # per-phase default tiles from the v5e sweep (benchmarks/exp_flash):
+    # fwd likes tall q blocks (fewer online-softmax state rounds); the
+    # fused backward (which reads the dkv slot) measured best at
+    # (1024, 2048) inside the full train step (82.3 ms vs 84.5 at
+    # 1024^2 on the B16/T8192 bench), matching the split dkv optimum —
+    # its accumulators live on the k axis; d=128 halves everything for
+    # VMEM.  Explicit block_q/block_k pin all phases.
+    if block_q is None and block_k is None:
+        tiles = (((2048, 1024), (1024, 2048), (1024, 1024))
+                 if q.shape[-1] <= 64
+                 else ((512, 512), (512, 512), (512, 512)))
+    else:
+        bq = int(block_q if block_q is not None else block_k)
+        bk = int(block_k if block_k is not None else block_q)
+        tiles = ((bq, bk),) * 3
     qf, kf, vf, restore = _to_bhtd(q, k, v)
     qo = jnp.asarray(q_offset, jnp.int32)
     ko = jnp.asarray(k_offset, jnp.int32)
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
     o, lse = _flash_with_lse(qf, kf, vf, qo, ko, bool(causal),
-                             float(scale), int(block_q), int(block_k),
+                             float(scale), tiles,
                              bool(interpret),
                              _bwd_mode_from_env(bool(interpret)))
     if restore is None:
@@ -523,9 +807,9 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
 
     Returns softmax(q k^T * scale [+ causal mask]) v with O(block) live
     memory on-chip.  Differentiable (Pallas backward on TPU, flash
-    recompute scan elsewhere).  Default tiles are head-dim aware
-    (1024 for d<=64, else 512 — ~4x over the original 128 on v5e
-    fwd+bwd; 2048 overflows Mosaic VMEM).
+    recompute scan elsewhere).  Default tiles are head-dim-aware and
+    per-phase (see attention_with_lse); explicit block_q/block_k pin
+    every phase to one tile for testing.
     """
     squeeze = False
     if q.ndim == 3:

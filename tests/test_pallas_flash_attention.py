@@ -153,10 +153,14 @@ def test_flash_grads_match_dense(causal):
                                    err_msg='d' + name)
 
 
+@pytest.mark.parametrize('split', [False, True])
 @pytest.mark.parametrize('causal', [False, True])
-def test_pallas_backward_kernels_match_scan(causal, monkeypatch):
-    """The TPU Pallas backward (dkv + dq kernels, interpret mode here)
-    must produce the same grads as the jax-scan flash recompute."""
+def test_pallas_backward_kernels_match_scan(causal, split, monkeypatch):
+    """The TPU Pallas backward must produce the same grads as the
+    jax-scan flash recompute — both the default fused k-major kernel
+    and (split=True, via PADDLE_TPU_FLASH_BWD_SPLIT) the dkv/dq split
+    pair, which stays the automatic fallback for sequences whose dq
+    accumulator exceeds _FUSED_DQ_BYTES."""
     b, t, h, d = 2, 160, 2, 32  # non-multiple of the block: padding path
     q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
@@ -173,14 +177,44 @@ def test_pallas_backward_kernels_match_scan(causal, monkeypatch):
     g_scan = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_SCAN')
     monkeypatch.setenv('PADDLE_TPU_FLASH_BWD_PALLAS', '1')
+    if split:
+        monkeypatch.setenv('PADDLE_TPU_FLASH_BWD_SPLIT', '1')
     jax.clear_caches()
     g_pal = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_PALLAS')
+    if split:
+        monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_SPLIT')
     jax.clear_caches()
     for a, b_, name in zip(g_scan, g_pal, 'qkv'):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-5,
                                    err_msg='d' + name)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_pallas_backward_mixed_tiles_match_scan(causal):
+    """The split dkv/dq kernels may run with DIFFERENT tile pairs
+    (shared padding goes to the lcm of the block sizes); grads must
+    stay exact vs the scan recompute."""
+    import importlib
+    fa = importlib.import_module('paddle_tpu.ops.pallas.flash_attention')
+
+    bh, t, d = 3, 160, 32
+    scale = d ** -0.5
+    q = jnp.asarray(rng.randn(bh, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(bh, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(bh, t, d), jnp.float32)
+    do = jnp.asarray(rng.randn(bh, t, d), jnp.float32)
+
+    o, lse = fa._fa_forward_sliced(q, k, v, causal, scale, 64, 64, True)
+    res = (q, k, v, jnp.int32(0), jnp.int32(0), o, lse)
+    want = fa._fa_backward(causal, scale, 64, res, do)
+    got = fa._fa_backward_pallas(causal, scale, ((64, 32), (32, 64)),
+                                 res, do, None, interpret=True,
+                                 allow_fused=False)
+    for a, b_, name in zip(got, want, ('dq', 'dk', 'dv')):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
 
 
 def test_nets_attention_dense_fallback_matches_matmul_path():
