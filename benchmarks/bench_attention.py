@@ -26,16 +26,15 @@ def main():
 
     tpu = common.on_tpu()
     if tpu:
-        # B=16 fills the chip.  r5: 1.53M tok/s / 45 TFLOPS honest
-        # fwd+bwd (the r1-r4 ~57 TFLOPS lines had the dkv kernel
-        # DCE'd away — see the step() comment); per-phase roofline in
-        # PERF.md says this is ~50% of the chip's MEASURED 101.6
-        # TFLOPS square-matmul peak, the D=64 shape ceiling
+        # B=16 fills the chip.  r5: honest fwd+bwd (the r1-r4 ~57
+        # TFLOPS lines had the dkv kernel DCE'd away — see the step()
+        # comment), K=50 scan chains (a python loop pays a tunnel
+        # round trip per launch); PERF.md has the per-phase roofline
         B, T, H, D = 16, 8192, 8, 64
-        steps, warmup = 10, 2
+        steps = 50
     else:
         B, T, H, D = 1, 512, 2, 32
-        steps, warmup = 2, 1
+        steps = 2
 
     rng = np.random.default_rng(0)
     dt = jnp.bfloat16 if tpu else jnp.float32
@@ -47,27 +46,36 @@ def main():
         return jnp.sum(flash_attention(q, k, v, causal=True)
                        .astype(jnp.float32))
 
-    # chain (q, k, v) <- sgd(step) so each step depends on the previous
-    # one: the device serializes the chain and ONE final sync times all
-    # steps (a per-step host sync would measure the tunnel RTT instead).
+    # K steps as ONE lax.scan chain, (q, k, v) <- sgd(step): the chain
+    # serializes on-device and ONE scalar pull syncs it (a python loop
+    # of per-step jit calls pays a tunnel round trip PER LAUNCH, and a
+    # per-step host sync would measure the tunnel RTT instead).
     # ALL THREE grads must feed the chain: consuming only dq lets XLA
     # dead-code-eliminate the dkv backward kernel outright (the r1-r4
     # lines did exactly that — they timed fwd+dq, not fwd+bwd).
-    @jax.jit
     def step(q, k, v):
         dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         return ((q - 1e-3 * dq).astype(q.dtype),
                 (k - 1e-3 * dk).astype(k.dtype),
                 (v - 1e-3 * dv).astype(v.dtype))
 
-    qq, kk, vv = step(q, k, v)
-    np.asarray(qq[0, 0, 0])  # sync
+    @jax.jit
+    def chain(q, k, v):
+        def body(c, _):
+            return step(*c), None
+        out, _ = jax.lax.scan(body, (q, k, v), None, length=steps)
+        return out
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        qq, kk, vv = step(qq, kk, vv)
-    np.asarray(qq[0, 0, 0])  # sync the whole chain
-    dt_s = (time.perf_counter() - t0) / steps
+    qq, kk, vv = chain(q, k, v)
+    np.asarray(qq[0, 0, 0])  # compile + sync
+
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        qq, kk, vv = chain(q, k, v)
+        np.asarray(qq[0, 0, 0])  # sync the whole chain
+        samples.append((time.perf_counter() - t0) / steps)
+    dt_s = float(np.median(samples))
 
     tokens_s = B * T / dt_s
     # causal fwd 2*B*H*T^2*D MACs * 0.5, bwd ~2.5x fwd (flash recompute)

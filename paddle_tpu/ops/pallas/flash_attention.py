@@ -770,15 +770,14 @@ def attention_with_lse(q, k, v, causal=False, scale=None, block_q=None,
     masking across ring-rotated K/V shards."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    # per-phase default tiles from the v5e sweep (benchmarks/exp_flash):
-    # fwd likes tall q blocks (fewer online-softmax state rounds); the
-    # fused backward (which reads the dkv slot) measured best at
-    # (1024, 2048) inside the full train step (82.3 ms vs 84.5 at
-    # 1024^2 on the B16/T8192 bench), matching the split dkv optimum —
-    # its accumulators live on the k axis; d=128 halves everything for
-    # VMEM.  Explicit block_q/block_k pin all phases.
+    # per-phase default tiles from the v5e sweep (benchmarks/exp_flash,
+    # steps=100 chains — short chains are launch-overhead-dominated):
+    # fwd 24.4 ms at 2048^2 vs 26.1 at 1024^2; the fused backward
+    # (which reads the dkv slot) 48.7 ms at (1024, 2048) vs 50.1 at
+    # 1024^2 — its accumulators live on the k axis; d=128 halves
+    # everything for VMEM.  Explicit block_q/block_k pin all phases.
     if block_q is None and block_k is None:
-        tiles = (((2048, 1024), (1024, 2048), (1024, 1024))
+        tiles = (((2048, 2048), (1024, 2048), (1024, 1024))
                  if q.shape[-1] <= 64
                  else ((512, 512), (512, 512), (512, 512)))
     else:
