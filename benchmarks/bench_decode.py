@@ -3,8 +3,21 @@ counterpart of bench_seq2seq (reference book decode path: While-loop
 beam lattice, layers.beam_search / beam_search_decode).
 
 The decode program is one XLA While computation (the beam loop lowers
-to a lax.scan), so a whole [B, K]-beam generation is a single
-dispatch; per-call wall includes that dispatch."""
+to a lax.scan).  K decodes ride ONE Executor.run_steps dispatch (the
+predict_many treatment): rounds 1-4 timed a python loop of per-call
+dispatches, which on the tunneled chip measures the ~0.1 s per-launch
+round trip, not the decoder (the r4 "81k tok/s" line).
+
+Headline metric is GENERATED SEQUENCE tokens (batch x max_len) per
+second — the conventional decode-throughput accounting.  The beam-
+expanded rate (x beam_size hypotheses actually extended per step) is
+reported as a secondary field, not the headline (r4 advisor item).
+
+Prints ONE JSON line with the wall-vs-device split: device_ms_per_decode
+comes from the K-chain (one dispatch amortized over K), and
+dispatch_ms_per_call is the single-call residual over it.
+"""
+import json
 import time
 
 import numpy as np
@@ -18,7 +31,7 @@ def main():
 
     if on_tpu():
         batch, seq, vocab, dim, beam, max_len = 64, 64, 30000, 512, 4, 32
-        reps = 20
+        reps = 50
     else:
         batch, seq, vocab, dim, beam, max_len = 4, 8, 100, 32, 2, 5
         reps = 2
@@ -39,26 +52,44 @@ def main():
     feed = {'src_word_id': (rng.integers(
         1, vocab, (batch, seq, 1)).astype(np.int32), ln)}
 
-    out = exe.run(main_p, feed=feed, fetch_list=[ids, scores],
-                  return_numpy=False)  # compile + warm
+    # K decodes as one compiled scan, one dispatch, one sync
+    out = exe.run_steps(main_p, feed=feed, fetch_list=[ids],
+                        repeat=reps, return_numpy=False)  # compile+warm
     np.asarray(out[0])
-
-    samples = []
+    samples, walls = [], []
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(reps):
-            out = exe.run(main_p, feed=feed, fetch_list=[ids, scores],
-                          return_numpy=False)
+        out = exe.run_steps(main_p, feed=feed, fetch_list=[ids],
+                            repeat=reps, return_numpy=False)
         np.asarray(out[0])
         dt = time.perf_counter() - t0
-        # generated tokens: every step extends B x K live hypotheses
-        samples.append(batch * beam * max_len * reps / dt)
-    import json
+        walls.append(dt)
+        samples.append(batch * max_len * reps / dt)
+    dev_ms = float(np.median(walls)) / reps * 1e3
+
+    # single-call wall (the r1-r4 measurement): the residual over the
+    # chained per-decode time is per-dispatch tunnel cost
+    out = exe.run(main_p, feed=feed, fetch_list=[ids],
+                  return_numpy=False)
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    out = exe.run(main_p, feed=feed, fetch_list=[ids],
+                  return_numpy=False)
+    np.asarray(out[0])
+    single_ms = (time.perf_counter() - t0) * 1e3
+
+    val = float(np.median(samples))
     print(json.dumps({
         'metric': 'seq2seq_beam_decode_tokens_per_sec',
-        'value': round(float(np.median(samples)), 2),
+        'value': round(val, 2),
         'samples': [round(s, 1) for s in samples],
-        'note': 'batch=%d beam=%d max_len=%d vocab=%d dim=%d'
+        'beam_expanded_tokens_per_sec': round(val * beam, 1),
+        'device_ms_per_decode': round(dev_ms, 2),
+        'dispatch_ms_per_call': round(max(single_ms - dev_ms, 0.0), 2),
+        'chain': reps,
+        'note': 'batch=%d beam=%d max_len=%d vocab=%d dim=%d; headline '
+                'counts batch*max_len generated tokens (beam-expanded '
+                'rate is the secondary field)'
                 % (batch, beam, max_len, vocab, dim)}))
 
 

@@ -147,11 +147,34 @@ def main():
                         np.asarray(o)
                 samples.append(batch * thr_chain_b /
                                (time.perf_counter() - t0))
+            # split the pipelined wall like the chained line above so a
+            # predict_async regression is distinguishable from tunnel
+            # weather: device compute from a short stacked chain on a
+            # device-resident batch, upload from one timed device_put,
+            # dispatch = residual wall per call
+            dev_chain = 10
+            stacked = {'img': jax.device_put(
+                np.stack([x] * dev_chain), place.jax_device())}
+            ys = server.predict_stacked(stacked, dev_chain)  # compile
+            [np.asarray(y) for y in ys]
+            t0 = time.perf_counter()
+            ys = server.predict_stacked(stacked, dev_chain)
+            [np.asarray(y) for y in ys]
+            dev_ms = (time.perf_counter() - t0) / dev_chain * 1e3
+            t0 = time.perf_counter()
+            np.asarray(jax.device_put(x, place.jax_device())[0, 0, 0])
+            up_ms = (time.perf_counter() - t0) * 1e3
+            wall_ms = batch / float(np.median(samples)) * 1e3
             r = {"metric": "resnet%d_serving_pipelined_img_s_b%d"
                            % (depth, batch),
                  "value": round(float(np.median(samples)), 2),
                  "samples": [round(s, 1) for s in samples],
-                 "unit": "img/s", "dtype": "bfloat16"}
+                 "unit": "img/s", "dtype": "bfloat16",
+                 "device_ms_per_batch": round(dev_ms, 2),
+                 "stage_mb_s": round(x.nbytes / 1e6 / max(up_ms / 1e3,
+                                                          1e-9), 1),
+                 "dispatch_ms_per_call": round(
+                     max(wall_ms - dev_ms - up_ms, 0.0), 2)}
         print(json.dumps(r))
         results.append(r)
     return results

@@ -271,8 +271,10 @@ def _referenced_generations(dirname):
 
 def _gc_stale_generations(dirname, names, floor_gen):
     """Delete a var's generation-suffixed data files whose generation is
-    (a) below ``floor_gen`` — the save that just completed; gens at or
-    above it may belong to a synchronized sibling still mid-write — and
+    (a) below ``floor_gen - 1`` — the save that just completed is
+    ``floor_gen``; gens at or above it may belong to a synchronized
+    sibling still mid-write, and gen ``floor_gen - 1`` is spared too so
+    a sibling lagging one full checkpoint behind is never swept — and
     (b) referenced by no manifest in the directory (live or ``.prev``
     archive, see _referenced_generations).  This sweeps torn generations
     (data files whose save crashed before its manifest) without ever
@@ -294,7 +296,19 @@ def _gc_stale_generations(dirname, names, floor_gen):
     pat = re.compile(
         r'^(.+?)\.(?:shard\.g(\d+)\.(?:[0-9_x]+|scalar)|g(\d+))\.npy$')
     wanted = {_safe(n) for n in names}
+    # a var whose NAME itself ends in '.g<digits>' (e.g. 'w.g5') saves
+    # the legacy un-suffixed file 'w.g5.npy', which the pattern above
+    # would misparse as generation 5 of var 'w' — exact legacy names of
+    # saved vars are never GC candidates
+    legacy = {_safe(n) + '.npy' for n in names}
+    # never sweep the immediately-previous generation either: a
+    # synchronized sibling host can lag a FULL checkpoint behind (still
+    # writing gen N-1 data, its manifest not yet on disk) and gen N-1
+    # would otherwise be unreferenced from this host's point of view
+    floor_gen = floor_gen - 1
     for fname in entries:
+        if fname in legacy:
+            continue
         m = pat.match(fname)
         if not m or m.group(1) not in wanted:
             continue
@@ -686,7 +700,17 @@ def write_step_file(dirname, step):
     data/LR-schedule position against older weights."""
     path = os.path.join(dirname, 'STEP')
     if os.path.exists(path):
-        _archive_prev(path)
+        # archive only when the step ADVANCES (mirrors the manifest's
+        # _advances_generation gate): re-saving the same step must not
+        # overwrite STEP.prev with the current step, or the archived
+        # (params, step) rollback pair desynchronizes
+        try:
+            with open(path) as f:
+                on_disk = int(f.read().strip())
+        except (OSError, ValueError):
+            on_disk = None
+        if on_disk is None or int(step) > on_disk:
+            _archive_prev(path)
     # tmp+rename, NOT in-place: the archive may be a hardlink to the
     # current file's inode, and an in-place truncate-and-write would
     # update STEP.prev right along with STEP

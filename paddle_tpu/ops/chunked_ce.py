@@ -16,6 +16,7 @@ win whenever V is large enough that the logits don't fit cache — the
 regime the vocab head lives in.
 """
 import functools
+import os
 
 import numpy as np
 
@@ -175,9 +176,27 @@ def _dense_ce_bwd(res, g):
 _dense_linear_ce.defvjp(_dense_ce_fwd, _dense_ce_bwd)
 
 
-# auto mode switches to the chunked scan once the half-width logits
-# residual would exceed this budget (bytes)
-_DENSE_BYTES_BUDGET = 2 << 30
+def _dense_bytes_budget():
+    """Budget for the dense path's activation-dtype logits residual:
+    1/8 of the attached device's HBM (2 GB on a 16 GB v5e — the
+    measured crossover on that part), derived from memory_stats()
+    rather than hardcoded so smaller/larger-HBM parts switch to the
+    chunked scan at an equivalent occupancy.
+    PADDLE_TPU_DENSE_CE_BUDGET_MB overrides."""
+    mb = os.environ.get('PADDLE_TPU_DENSE_CE_BUDGET_MB')
+    if mb:
+        try:
+            return int(float(mb) * 1024 * 1024)
+        except ValueError:
+            pass
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        hbm = int(stats.get('bytes_limit', 0))
+    except Exception:
+        hbm = 0
+    if hbm <= 0:
+        hbm = 16 << 30  # v5e default when the backend has no stats
+    return hbm // 8
 
 
 @register_op('fused_linear_softmax_ce')
@@ -185,7 +204,7 @@ def _fused_linear_softmax_ce(ctx, ins, attrs):
     """X [.., D] → per-position CE loss [.., 1] against Label [.., 1]
     through the W [D, V] / Bias [V] vocab head.  mode='auto' (default)
     picks the dense single-matmul VJP while its activation-dtype logits
-    residual fits _DENSE_BYTES_BUDGET, else the chunked scan that never
+    residual fits _dense_bytes_budget(), else the chunked scan that never
     materializes [N, V] at all.  'dense'/'chunked' force a path."""
     x = first(ins, 'X')
     w = first(ins, 'W')
@@ -204,7 +223,7 @@ def _fused_linear_softmax_ce(ctx, ins, attrs):
     lab = label.astype(jnp.int32).reshape(-1)
     n = int(np.prod(lead)) if lead else 1
     if mode == 'auto':
-        mode = ('dense' if n * v * x.dtype.itemsize <= _DENSE_BYTES_BUDGET
+        mode = ('dense' if n * v * x.dtype.itemsize <= _dense_bytes_budget()
                 else 'chunked')
     if mode == 'dense':
         loss = _dense_linear_ce(x.reshape(-1, d), w, b, lab)
