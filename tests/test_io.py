@@ -4,6 +4,8 @@ checkpoint/resume.
 Reference parity: python/paddle/v2/fluid/io.py usage in the book tests
 (save_inference_model / load_inference_model) and A2 checkpoint/resume.
 """
+import os
+
 import numpy as np
 
 import paddle_tpu as fluid
@@ -457,26 +459,37 @@ def test_crash_before_manifest_preserves_old_checkpoint(tmp_path,
         np.testing.assert_array_equal(
             np.asarray(scope.find_var(name)), val, err_msg=name)
 
-    # recovery: training resumes and a LATER save succeeds — GC sweeps
-    # the torn generation (gen 3, referenced by no manifest) but keeps
-    # the generation the archived .prev manifest references (gen 2),
-    # which still restores the step-1 state
+    # recovery: training resumes and a LATER save succeeds.  The torn
+    # generation (gen 3, referenced by no manifest) is SPARED at the
+    # gen-4 save — GC never sweeps the immediately-previous generation,
+    # which on multi-host may be a lagging sibling still mid-write —
+    # and swept one save later, at gen 5.  The generation the archived
+    # .prev manifest references survives throughout.
     import glob
     import os
     import re
+
+    def on_disk_gens():
+        return {int(m.group(1))
+                for f in glob.glob(ckpt + '/*.npy')
+                for m in [re.search(r'\.g(\d+)\.', os.path.basename(f))]
+                if m}
+
     _train_steps(exe, main, loss, 1, seed=2)
     io.save_checkpoint(exe, ckpt, main, step=3)
-    gens = {int(m.group(1))
-            for f in glob.glob(ckpt + '/*.npy')
-            for m in [re.search(r'\.g(\d+)\.', os.path.basename(f))]
-            if m}
-    assert gens == {2, 4}, gens
+    assert on_disk_gens() == {2, 3, 4}, on_disk_gens()
     os.replace(os.path.join(ckpt, '__manifest__.json.prev'),
                os.path.join(ckpt, '__manifest__.json'))
     io.load_persistables(exe, ckpt, main)
     for name, val in saved.items():
         np.testing.assert_array_equal(
             np.asarray(scope.find_var(name)), val, err_msg=name)
+
+    # one more save sweeps the torn gen 3 (now two generations back):
+    # gen 5 is live, gen 2 is referenced by the new .prev archive, and
+    # gen 4 sits inside the one-generation grace window
+    io.save_checkpoint(exe, ckpt, main, step=4)
+    assert on_disk_gens() == {2, 4, 5}, on_disk_gens()
 
 
 def test_generation_gc_keeps_rollback(tmp_path):
@@ -528,3 +541,37 @@ def test_generation_gc_keeps_rollback(tmp_path):
     for name, val in at_step[2].items():
         np.testing.assert_array_equal(
             np.asarray(scope.find_var(name)), val, err_msg=name)
+
+
+def test_gc_never_deletes_legacy_file_of_dotted_var_name(tmp_path):
+    """A var literally named 'w.g5' saves the legacy un-suffixed file
+    'w.g5.npy'; the GC filename parser must not read that as
+    generation 5 of a var named 'w' and delete the only copy."""
+    from paddle_tpu import io
+
+    d = str(tmp_path)
+    np.save(os.path.join(d, 'w.g5.npy'), np.zeros(2))   # legacy of 'w.g5'
+    np.save(os.path.join(d, 'w.g1.npy'), np.zeros(2))   # gen 1 of 'w'
+    io._gc_stale_generations(d, ['w', 'w.g5'], floor_gen=9)
+    left = sorted(os.listdir(d))
+    assert 'w.g5.npy' in left, left          # legacy file survives
+    assert 'w.g1.npy' not in left, left      # true stale gen swept
+
+
+def test_step_prev_archives_only_on_advance(tmp_path):
+    """Re-saving the same step must not overwrite STEP.prev: the
+    archived (params, step) rollback pair would desynchronize."""
+    import os
+
+    from paddle_tpu import io
+
+    d = str(tmp_path)
+    io.write_step_file(d, 1)
+    io.write_step_file(d, 2)
+    with open(os.path.join(d, 'STEP.prev')) as f:
+        assert f.read().strip() == '1'
+    io.write_step_file(d, 2)  # same step again (e.g. retried save)
+    with open(os.path.join(d, 'STEP.prev')) as f:
+        assert f.read().strip() == '1', "re-save clobbered STEP.prev"
+    with open(os.path.join(d, 'STEP')) as f:
+        assert f.read().strip() == '2'
