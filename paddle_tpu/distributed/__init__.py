@@ -1,6 +1,8 @@
 from . import launch, transpiler
 from .pipeline import PipelineTranspiler
+from .tensor_parallel import TensorParallel, TensorParallelTranspiler
 from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
 
 __all__ = ['transpiler', 'launch', 'DistributeTranspiler',
-           'SimpleDistributeTranspiler', 'PipelineTranspiler']
+           'SimpleDistributeTranspiler', 'PipelineTranspiler',
+           'TensorParallelTranspiler', 'TensorParallel']

@@ -230,3 +230,63 @@ def _fused_linear_softmax_ce(ctx, ins, attrs):
     else:
         loss = _chunked_linear_ce(x.reshape(-1, d), w, b, lab, chunk)
     return {'Loss': [loss.reshape(lead + (1,))]}
+
+
+@register_op('vocab_parallel_ce')
+def _vocab_parallel_ce(ctx, ins, attrs):
+    """Tensor-parallel form of fused_linear_softmax_ce: the W [D, V]
+    vocab head is column-sharded over the ``tp_axis`` mesh axis and the
+    loss runs parallel/tensor_parallel.vocab_parallel_cross_entropy
+    inside shard_map — neither the full head nor any [N, V] logits ever
+    exist on one chip; the global logsumexp is one pmax + one psum over
+    ICI.  TensorParallelTranspiler swaps fused_linear_softmax_ce ops to
+    this type (ref precedent: distribute_transpiler.py transpile()
+    rewriting programs for distribution).  With no mesh bound, or a
+    1-wide/absent tp axis, it degrades to the single-chip fused op —
+    the same program runs anywhere."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import api as papi
+
+    x = first(ins, 'X')
+    w = first(ins, 'W')
+    b = first(ins, 'Bias')
+    label = first(ins, 'Label')
+    axis = attrs.get('tp_axis', 'tp')
+    flatten = int(attrs.get('flatten', x.ndim - 1))
+    lead = x.shape[:flatten]
+    d = int(np.prod(x.shape[flatten:]))
+    v = w.shape[1]
+
+    mesh = papi.current_mesh()
+    if (mesh is None or axis not in mesh.axis_names
+            or mesh.shape[axis] == 1):
+        return _fused_linear_softmax_ce(ctx, ins, attrs)
+    size = mesh.shape[axis]
+    if v % size:
+        raise ValueError(
+            "vocab_parallel_ce: vocab %d not divisible by tp axis %r "
+            "size %d" % (v, axis, size))
+
+    if b is None:
+        b = jnp.zeros((v,), jnp.float32)
+    xf = x.reshape(-1, d)
+    lab = label.astype(jnp.int32).reshape(-1)
+
+    # batch stays sharded over the remaining mesh axes (dp/fsdp riders
+    # compose); only the vocab dim maps onto tp inside the shard_map
+    batch_axes = tuple(a for a in mesh.axis_names
+                       if a != axis and mesh.shape[a] > 1)
+    bspec = batch_axes if batch_axes else None
+
+    from ..parallel.collective import shard_map
+    from ..parallel.tensor_parallel import vocab_parallel_cross_entropy
+
+    def body(xs, ws, bs, ls):
+        return vocab_parallel_cross_entropy(xs, ws, bs, ls, axis)
+
+    loss = shard_map(
+        body, mesh,
+        in_specs=(P(bspec, None), P(None, axis), P(axis), P(bspec)),
+        out_specs=P(bspec), check_vma=False)(xf, w, b, lab)
+    return {'Loss': [loss.reshape(lead + (1,))]}

@@ -123,13 +123,28 @@ def _fetch_np(v):
     return np.asarray(v)
 
 
+def _state_sharding(mesh, name, value, param_axis, shard_plan):
+    """Sharding for one persistable: an explicit per-name PartitionSpec
+    from `shard_plan` (tensor parallelism) wins; otherwise the uniform
+    `param_axis` heuristic (fsdp) or replication."""
+    if shard_plan and name in shard_plan:
+        return NamedSharding(mesh, shard_plan[name])
+    return param_sharding(mesh, param_axis, np.shape(value))
+
+
+def _plan_key(shard_plan):
+    return tuple(sorted((n, str(s)) for n, s in (shard_plan or {}).items()))
+
+
 def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
-                param_axis=None, donate=True):
+                param_axis=None, donate=True, shard_plan=None):
     """Execute one step of `program` SPMD over the current mesh.
 
     The executor's traced step function is re-jitted with NamedSharding
-    constraints: feeds batch-sharded over `batch_axis`, persistable state
-    sharded over `param_axis` where divisible (replicated otherwise).
+    constraints: feeds batch-sharded over `batch_axis` (None replicates),
+    persistable state sharded over `param_axis` where divisible
+    (replicated otherwise), with `shard_plan` ({name: PartitionSpec})
+    overriding per-parameter — the tensor-parallel head/embedding plan.
     GSPMD propagates the rest; gradient psums over dp and activation
     collectives over tp appear in the lowered HLO automatically.
     """
@@ -145,9 +160,9 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
 
     feed_sh = {n: batch_sharding(mesh, batch_axis, np.ndim(v))
                for n, v in feed_arrays.items()}
-    rw_sh = {n: param_sharding(mesh, param_axis, np.shape(v))
+    rw_sh = {n: _state_sharding(mesh, n, v, param_axis, shard_plan)
              for n, v in state_rw.items()}
-    ro_sh = {n: param_sharding(mesh, param_axis, np.shape(v))
+    ro_sh = {n: _state_sharding(mesh, n, v, param_axis, shard_plan)
              for n, v in state_ro.items()}
     key_sh = replicate(mesh)
 
@@ -162,6 +177,7 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
                 for d in (feed_arrays, state_rw, state_ro)
                 for n, v in sorted(d.items()))
     key = (program._uid, program.version, mesh, batch_axis, param_axis,
+           _plan_key(shard_plan),
            tuple(getattr(f, 'name', str(f)) for f in fetch_list), donate,
            sig)
     fn = cache.get(key)
@@ -194,7 +210,8 @@ def run_sharded(exe, program, feed, fetch_list, scope, batch_axis='dp',
 
 
 def run_steps_sharded(exe, program, feed, fetch_list, scope,
-                      batch_axis='dp', param_axis=None, repeat=None):
+                      batch_axis='dp', param_axis=None, repeat=None,
+                      shard_plan=None):
     """K SPMD train steps as ONE sharded lax.scan over the mesh — the
     run_sharded counterpart of Executor.run_steps: persistable state is
     the donated carry (it never leaves the mesh between steps) and the
@@ -228,9 +245,9 @@ def run_steps_sharded(exe, program, feed, fetch_list, scope,
                for n, v in feed_arrays.items()}
     xs_sh = {n: NamedSharding(mesh, P(None, *s.spec))
              for n, s in feed_sh.items()}
-    rw_sh = {n: param_sharding(mesh, param_axis, np.shape(v))
+    rw_sh = {n: _state_sharding(mesh, n, v, param_axis, shard_plan)
              for n, v in state_rw.items()}
-    ro_sh = {n: param_sharding(mesh, param_axis, np.shape(v))
+    ro_sh = {n: _state_sharding(mesh, n, v, param_axis, shard_plan)
              for n, v in state_ro.items()}
     key_sh = replicate(mesh)
 
@@ -242,7 +259,7 @@ def run_steps_sharded(exe, program, feed, fetch_list, scope,
                 for d in (feed_arrays, state_rw, state_ro)
                 for n, v in sorted(d.items()))
     mkey = ('multi', program._uid, program.version, mesh, batch_axis,
-            param_axis, k, stacked,
+            param_axis, _plan_key(shard_plan), k, stacked,
             tuple(getattr(f, 'name', str(f)) for f in fetch_list), sig)
     fn = cache.get(mkey)
     if fn is None:
