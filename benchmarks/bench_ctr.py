@@ -1,29 +1,50 @@
 """BASELINE config 5: CTR DeepFM with high-dim sparse tables —
 examples/s (SelectedRows grads keep the vocab-height dense grad off the
-chip)."""
+chip).
+
+Round 5: Criteo-class scale — 26 sparse slots x ~1e6-row tables (the
+r1-r4 line ran 8 slots x 1e5, which never stressed SelectedRows where
+it matters).  A second JSON line sweeps the TABLE HEIGHT at a fixed
+batch and reports the compiled step's memory_analysis per height.
+
+What the sweep shows (PERF.md "CTR at Criteo scale" has the full
+bisect): MEMORY is row-sparse end-to-end — temp bytes stay ~flat vs
+table bytes, no [V, K] dense gradient ever materializes — but step
+TIME retains a table-height term, because XLA:TPU lowers scatter-add
+as a pass over the operand (measured ~1 ns/table-row + ~28 ns/touched
+-row; forward/backward are height-flat, only the optimizer scatters
+scale).  That is a TensorCore scatter-lowering property (the hardware
+answer to it is SparseCore), not a SelectedRows failure: a dense-grad
+design would pay the same table passes PLUS dense-grad materialization
+and traffic.
+"""
+import json
+import time
+
 import numpy as np
 
 from common import run_bench, on_tpu
 
 
-def main():
+def _build_fn(arch, sparse_dim, num_slots, embed_dim):
     import paddle_tpu as fluid
     from paddle_tpu import models
-
-    # batch 32768: +14% over 16384 (sparse tables amortize)
-    batch = 32768 if on_tpu() else 64
 
     def build():
         main_p, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_p, startup):
-            feeds, predict, avg_cost, auc = models.ctr.build('deepfm')
+            feeds, predict, avg_cost, auc = models.ctr.build(
+                arch, sparse_dim=sparse_dim, num_slots=num_slots,
+                embed_dim=embed_dim)
             fluid.optimizer.AdagradOptimizer(0.01).minimize(avg_cost)
         assert any(op.type == 'sparse_grad_assemble'
                    for op in main_p.global_block().ops)
         return main_p, startup, avg_cost
+    return build
 
-    from paddle_tpu.models.ctr import (DENSE_DIM, NUM_SLOTS,
-                                       SPARSE_FEATURE_DIM)
+
+def _feed_fn(batch, sparse_dim, num_slots):
+    from paddle_tpu.models.ctr import DENSE_DIM
     rng = np.random.default_rng(0)
 
     def feed():
@@ -31,16 +52,91 @@ def main():
         out = {'dense': rng.normal(size=(batch, DENSE_DIM)).astype(
             np.float32),
             'label': rng.integers(0, 2, (batch, 1)).astype(np.int32)}
-        for i in range(NUM_SLOTS):
+        for i in range(num_slots):
             out['sparse_%d' % i] = (rng.integers(
-                0, SPARSE_FEATURE_DIM, (batch, 1, 1)).astype(np.int32), ln)
+                0, sparse_dim, (batch, 1, 1)).astype(np.int32), ln)
         return out
+    return feed
 
-    # K=100 amortizes the ~110 ms tunnel dispatch (+20% vs K=20)
-    run_bench('ctr_deepfm_examples_per_sec', batch, build, feed,
-              steps=100,
-              note='batch=%d slots=%d dim=%d' % (batch, NUM_SLOTS,
-                                                 SPARSE_FEATURE_DIM))
+
+def main():
+    from paddle_tpu.models.ctr import (CRITEO_NUM_SLOTS,
+                                       CRITEO_SPARSE_DIM)
+
+    tpu = on_tpu()
+    if tpu:
+        batch, sparse_dim, num_slots = 32768, CRITEO_SPARSE_DIM, \
+            CRITEO_NUM_SLOTS
+        steps = 100
+    else:
+        batch, sparse_dim, num_slots = 64, 1003, 4
+        steps = 3
+
+    # headline: Criteo-class DeepFM.  K=100 amortizes the ~110 ms
+    # tunnel dispatch
+    run_bench('ctr_deepfm_examples_per_sec', batch,
+              _build_fn('deepfm', sparse_dim, num_slots, 16),
+              _feed_fn(batch, sparse_dim, num_slots), steps=steps,
+              note='batch=%d slots=%d dim=%d (criteo-class)'
+                   % (batch, num_slots, sparse_dim))
+
+    # table-height sweep: same batch/slots/embed, tables 1e5 -> 1e7;
+    # touched rows per step constant (= batch x slots).  step_ms carries
+    # the XLA scatter table pass; mem_temp_over_tables staying ~flat is
+    # the no-dense-grad proof.
+    import jax
+    import paddle_tpu as fluid
+
+    sweep_batch = 16384 if tpu else 64
+    sweep_slots = 8 if tpu else 2
+    dims = ((100003, 1000003, 10000019) if tpu else (101, 1009))
+    rows = []
+    for dim in dims:
+        build = _build_fn('deepfm', dim, sweep_slots, 8)
+        feed = _feed_fn(sweep_batch, dim, sweep_slots)
+        main_p, startup, loss = build()
+        place = fluid.TPUPlace(0) if tpu else fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        # a fresh scope per height: the big tables free when it drops
+        scope = fluid.core.Scope()
+        exe.run(startup, scope=scope)
+        # compiled-step memory: temp vs table bytes (dense grads would
+        # put #tables extra V-passes in temp)
+        fn_c, args_c = exe.compile(main_p, feed=_feed_fn(
+            sweep_batch, dim, sweep_slots)(), fetch_list=[loss],
+            scope=scope)
+        ma = fn_c.lower(*args_c).compile().memory_analysis()
+        table_bytes = sweep_slots * dim * (8 + 1) * 4  # embeds + wide
+        mem_ratio = ma.temp_size_in_bytes / table_bytes
+        f = {k: (tuple(v) if isinstance(v, tuple)
+                 else jax.device_put(v, place.jax_device()))
+             for k, v in feed().items()}
+        k = 50 if tpu else 2
+        out = exe.run_steps(main_p, feed=f, fetch_list=[loss],
+                            repeat=k, return_numpy=False, scope=scope)
+        np.asarray(out[0])  # compile + warm
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = exe.run_steps(main_p, feed=f, fetch_list=[loss],
+                                repeat=k, return_numpy=False,
+                                scope=scope)
+            np.asarray(out[0])
+            ts.append((time.perf_counter() - t0) / k * 1e3)
+        rows.append({'table_rows': dim,
+                     'step_ms': round(float(np.median(ts)), 3),
+                     'temp_over_table_bytes': round(mem_ratio, 3)})
+        del scope
+    print(json.dumps({
+        'metric': 'ctr_table_height_sweep_step_ms',
+        'value': rows[-1]['step_ms'],
+        'sweep': rows,
+        'note': 'batch=%d slots=%d embed=8, %d touched rows/step; temp '
+                'bytes ~independent of table height (the ratio FALLS as '
+                'tables grow) = no dense [V,K] grad materializes; the '
+                'step_ms growth is the XLA:TPU scatter table pass '
+                '(PERF.md "CTR at Criteo scale")'
+                % (sweep_batch, sweep_slots, sweep_batch * sweep_slots)}))
 
 
 if __name__ == '__main__':

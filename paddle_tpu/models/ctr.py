@@ -14,21 +14,29 @@ SPARSE_FEATURE_DIM = 100003  # ~1e5 hashed id space per slot
 NUM_SLOTS = 8
 DENSE_DIM = 13
 
+# Criteo-class preset (BASELINE config 5 "high-dim sparse"): 26 sparse
+# slots x ~1e6-row hashed tables + 13 dense features — the scale where
+# SelectedRows matters (table >> HBM-comfortable, update << table)
+CRITEO_SPARSE_DIM = 1000003
+CRITEO_NUM_SLOTS = 26
 
-def _sparse_slots():
+
+def _sparse_slots(num_slots=None):
     return [
         fluid.layers.data(name='sparse_%d' % i, shape=[1], dtype='int64',
-                          lod_level=1) for i in range(NUM_SLOTS)
+                          lod_level=1)
+        for i in range(num_slots or NUM_SLOTS)
     ]
 
 
 def wide_and_deep(dense, sparse_slots, label, embed_dim=16,
-                  hidden=(256, 128, 64)):
+                  hidden=(256, 128, 64), sparse_dim=None):
+    sparse_dim = sparse_dim or SPARSE_FEATURE_DIM
     # deep: per-slot embeddings, sum-pooled over the slot's ids
     embeds = [
         fluid.layers.sequence_pool(
             input=fluid.layers.embedding(
-                input=s, size=[SPARSE_FEATURE_DIM, embed_dim],
+                input=s, size=[sparse_dim, embed_dim],
                 is_sparse=True, param_attr='embed_%d' % i),
             pool_type='sum') for i, s in enumerate(sparse_slots)
     ]
@@ -39,7 +47,7 @@ def wide_and_deep(dense, sparse_slots, label, embed_dim=16,
     wides = [
         fluid.layers.sequence_pool(
             input=fluid.layers.embedding(
-                input=s, size=[SPARSE_FEATURE_DIM, 1], is_sparse=True,
+                input=s, size=[sparse_dim, 1], is_sparse=True,
                 param_attr='wide_%d' % i),
             pool_type='sum') for i, s in enumerate(sparse_slots)
     ]
@@ -52,20 +60,22 @@ def wide_and_deep(dense, sparse_slots, label, embed_dim=16,
     return predict, avg_cost, auc
 
 
-def deepfm(dense, sparse_slots, label, embed_dim=16, hidden=(128, 128)):
+def deepfm(dense, sparse_slots, label, embed_dim=16, hidden=(128, 128),
+           sparse_dim=None):
     """DeepFM: linear + pairwise FM interaction + deep MLP, shared
     per-slot factor embeddings."""
+    sparse_dim = sparse_dim or SPARSE_FEATURE_DIM
     factors = [
         fluid.layers.sequence_pool(
             input=fluid.layers.embedding(
-                input=s, size=[SPARSE_FEATURE_DIM, embed_dim],
+                input=s, size=[sparse_dim, embed_dim],
                 is_sparse=True, param_attr='fm_embed_%d' % i),
             pool_type='sum') for i, s in enumerate(sparse_slots)
     ]
     linear = [
         fluid.layers.sequence_pool(
             input=fluid.layers.embedding(
-                input=s, size=[SPARSE_FEATURE_DIM, 1], is_sparse=True,
+                input=s, size=[sparse_dim, 1], is_sparse=True,
                 param_attr='fm_w_%d' % i),
             pool_type='sum') for i, s in enumerate(sparse_slots)
     ]
@@ -90,14 +100,19 @@ def deepfm(dense, sparse_slots, label, embed_dim=16, hidden=(128, 128)):
     return predict, avg_cost, auc
 
 
-def build(arch='wide_and_deep'):
-    """Returns (feed vars, predict, avg_cost, auc)."""
+def build(arch='wide_and_deep', sparse_dim=None, num_slots=None,
+          embed_dim=16):
+    """Returns (feed vars, predict, avg_cost, auc).  Defaults keep the
+    8-slot/1e5 layout; pass sparse_dim=CRITEO_SPARSE_DIM,
+    num_slots=CRITEO_NUM_SLOTS for the Criteo-class config."""
     dense = fluid.layers.data(name='dense', shape=[DENSE_DIM],
                               dtype='float32')
-    sparse_slots = _sparse_slots()
+    sparse_slots = _sparse_slots(num_slots)
     label = fluid.layers.data(name='label', shape=[1], dtype='int64')
     fn = {'wide_and_deep': wide_and_deep, 'deepfm': deepfm}[arch]
-    predict, avg_cost, auc = fn(dense, sparse_slots, label)
+    predict, avg_cost, auc = fn(dense, sparse_slots, label,
+                                embed_dim=embed_dim,
+                                sparse_dim=sparse_dim)
     return [dense] + sparse_slots + [label], predict, avg_cost, auc
 
 

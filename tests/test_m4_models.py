@@ -78,3 +78,36 @@ def test_resnet_space_to_depth_stem_trains():
         c, = exe.run(main_prog, feed={'img': x, 'label': y},
                      fetch_list=[cost])
         assert np.isfinite(np.ravel(c)[0])
+
+
+def test_ctr_criteo_scale_build_trains():
+    """Criteo-class layout (26 slots, CRITEO_SPARSE_DIM rows scaled
+    down for CI) builds, keeps the sparse-grad path, and trains."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+    from paddle_tpu.models.ctr import CRITEO_NUM_SLOTS, DENSE_DIM
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, predict, avg_cost, auc = models.ctr.build(
+            'deepfm', sparse_dim=5003, num_slots=CRITEO_NUM_SLOTS,
+            embed_dim=8)
+        fluid.optimizer.AdagradOptimizer(0.05).minimize(avg_cost)
+    assert any(op.type == 'sparse_grad_assemble'
+               for op in main_p.global_block().ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    bs = 32
+    ln = np.full((bs,), 1, np.int32)
+    feed = {'dense': rng.normal(size=(bs, DENSE_DIM)).astype('float32'),
+            'label': rng.integers(0, 2, (bs, 1)).astype('int32')}
+    for i in range(CRITEO_NUM_SLOTS):
+        feed['sparse_%d' % i] = (
+            rng.integers(0, 5003, (bs, 1, 1)).astype('int32'), ln)
+    losses = [float(np.ravel(exe.run(main_p, feed=feed,
+                                     fetch_list=[avg_cost])[0])[0])
+              for _ in range(6)]
+    assert losses[-1] < losses[0], losses
