@@ -9,6 +9,8 @@ from paddle_tpu import models
 
 @pytest.mark.parametrize('arch', ['wide_and_deep', 'deepfm'])
 def test_ctr_trains(arch):
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
     feeds, predict, avg_cost, auc = models.ctr.build(arch)
     opt = fluid.optimizer.AdamOptimizer(learning_rate=0.003)
     opt.minimize(avg_cost)
@@ -35,4 +37,9 @@ def test_ctr_trains(arch):
         for batch in reader():
             c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
             costs.append(float(np.ravel(c)[0]))
-    assert np.mean(costs[-4:]) < np.mean(costs[:4])
+    # reference book tests gate on hard exit criteria
+    # (test_recognize_digits_conv.py:66); the synthetic click task
+    # reaches ~0.20 from ~0.59 in this budget — 0.35 catches any
+    # optimizer/sparse-path regression a bare decrease would not
+    assert np.mean(costs[-4:]) < 0.35, \
+        (np.mean(costs[:4]), np.mean(costs[-4:]))

@@ -33,3 +33,7 @@ def test_gan_trains():
     assert all(np.isfinite(d_losses)) and all(np.isfinite(g_losses))
     # D should learn to separate real/fake better than chance initially
     assert np.mean(d_losses[-4:]) < np.mean(d_losses[:2])
+    # adversarial training has no accuracy to gate on; the band
+    # below (measured 1.31 final, 2*ln2 = 1.386 equilibrium) is as
+    # tight as the dynamics allow without flaking
+    assert np.mean(d_losses[-4:]) < 1.45, np.mean(d_losses[-4:])

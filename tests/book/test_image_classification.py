@@ -59,7 +59,11 @@ def test_image_classification(net):
     assert np.all(np.isfinite(costs))
     if net == 'resnet':
         # small enough to converge within the CI budget
-        assert np.mean(costs[-4:]) < np.mean(costs[:4])
+        # reference-form criteria; measured band (seeded): cost
+        # 2.44 -> 1.65, train acc -> 0.80 over this budget
+        assert np.mean(costs[-4:]) < 2.0, \
+            (np.mean(costs[:4]), np.mean(costs[-4:]))
+        assert np.mean(accs[-4:]) > 0.6, np.mean(accs[-4:])
     else:
         # VGG16 is so dropout-heavy (15 stacked dropouts) that per-batch
         # TRAIN cost is noise-dominated over a 24-step CI budget, so the

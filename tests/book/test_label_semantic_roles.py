@@ -7,6 +7,8 @@ from paddle_tpu import datasets, models
 
 
 def test_label_semantic_roles_trains():
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
     word_dict, verb_dict, label_dict = datasets.conll05.get_dict()
     feeds, feature_out, crf_decode, avg_cost = models.srl.build(
         len(word_dict), len(verb_dict), 2, len(label_dict))
@@ -28,5 +30,6 @@ def test_label_semantic_roles_trains():
             c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
             costs.append(float(np.ravel(c)[0]))
             assert np.isfinite(costs[-1])
-    assert np.mean(costs[-4:]) < np.mean(costs[:4]), \
+    # measured band: 44.5 -> 10.1 over this budget (seeded)
+    assert np.mean(costs[-4:]) < 18.0, \
         (np.mean(costs[:4]), np.mean(costs[-4:]))

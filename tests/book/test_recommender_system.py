@@ -7,6 +7,8 @@ from paddle_tpu import datasets, models
 
 
 def test_recommender_system():
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
     feed_order, scale_infer, avg_cost = models.recommender.build()
 
     opt = fluid.optimizer.SGDOptimizer(learning_rate=0.2)
@@ -32,5 +34,6 @@ def test_recommender_system():
         for batch in reader():
             c, = exe.run(feed=to_feed(batch), fetch_list=[avg_cost])
             costs.append(float(np.ravel(c)[0]))
-    assert np.mean(costs[-4:]) < np.mean(costs[:4]), \
+    # measured band: 5.52 -> 4.16 over this budget (seeded)
+    assert np.mean(costs[-4:]) < 4.8, \
         (np.mean(costs[:4]), np.mean(costs[-4:]))

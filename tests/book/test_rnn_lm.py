@@ -6,6 +6,8 @@ from paddle_tpu import datasets, models
 
 
 def test_rnn_lm_trains():
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
     word_dict = datasets.imikolov.build_dict()
     vocab = len(word_dict)
     src, target, avg_cost = models.rnn_lm.build(vocab, emb_dim=32,
@@ -27,4 +29,6 @@ def test_rnn_lm_trains():
         for batch in reader():
             c, = exe.run(feed=feeder.feed(batch), fetch_list=[avg_cost])
             costs.append(float(np.ravel(c)[0]))
-    assert np.mean(costs[-6:]) < np.mean(costs[:6])
+    # measured band: 7.63 -> 6.94 over this budget (seeded)
+    assert np.mean(costs[-6:]) < 7.2, \
+        (np.mean(costs[:6]), np.mean(costs[-6:]))

@@ -7,6 +7,8 @@ from paddle_tpu import datasets, models
 
 
 def test_word2vec_trains():
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
     word_dict = datasets.imikolov.build_dict()
     dict_size = len(word_dict)
     words, next_word, predict, avg_cost = models.word2vec.build(dict_size)
@@ -26,5 +28,6 @@ def test_word2vec_trains():
         for data in reader():
             c, = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost])
             costs.append(float(np.ravel(c)[0]))
-    assert np.mean(costs[-20:]) < np.mean(costs[:20]), \
+    # measured band: 7.38 -> 6.78 over this budget (seeded)
+    assert np.mean(costs[-20:]) < 7.1, \
         (np.mean(costs[:20]), np.mean(costs[-20:]))
