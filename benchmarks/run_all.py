@@ -8,7 +8,8 @@ import os
 HERE = os.path.dirname(os.path.abspath(__file__))
 BENCHES = ['bench_mnist.py', 'bench_vgg.py', 'bench_lstm_lm.py',
            'bench_seq2seq.py', 'bench_decode.py', 'bench_ctr.py',
-           'bench_attention.py', 'bench_serving.py']
+           'bench_attention.py', 'bench_serving.py',
+           'bench_feed.py']
 
 if __name__ == '__main__':
     failed = []

@@ -315,3 +315,46 @@ def test_xmap_readers_uses_native_backend():
 
     out = list(xmap_readers(lambda x: x + 1, source, 2, 4)())
     assert sorted(out) == list(range(1, 21))
+
+
+def test_feed_pipeline_multiworker_preserves_order():
+    """workers=3: fills run concurrently but batches arrive in step
+    order (worker w owns steps w, w+N, ...; consumer round-robins)."""
+    import numpy as np
+
+    from paddle_tpu.runtime.feed import FeedPipeline
+
+    n = 11
+
+    def fill(views, step):
+        if step >= n:
+            return False
+        views['x'][...] = step
+        return True
+
+    pipe = FeedPipeline({'x': ((4,), np.float32)}, fill, depth=6,
+                        workers=3)
+    got = [int(np.asarray(f['x'])[0]) for f in pipe]
+    assert got == list(range(n)), got
+    pipe.close()
+
+
+def test_feed_pipeline_multiworker_propagates_error():
+    import numpy as np
+    import pytest
+
+    from paddle_tpu.runtime.feed import FeedPipeline
+
+    def fill(views, step):
+        if step == 4:
+            raise ValueError("boom")
+        views['x'][...] = step
+        return True
+
+    pipe = FeedPipeline({'x': ((2,), np.float32)}, fill, depth=6,
+                        workers=2)
+    with pytest.raises(RuntimeError, match="producer failed"):
+        for i, f in enumerate(pipe):
+            if i > 16:  # the error step must surface promptly
+                break
+    pipe.close()
