@@ -1,0 +1,146 @@
+"""Per-conv FLOPs roofline for the ResNet-50 b64 train step (PERF.md
+round-5 item #4).
+
+Times every distinct conv shape of ResNet-50 at 224² NHWC bf16 in
+isolation — forward, input-grad (dgrad) and weight-grad (wgrad) each as
+their own jitted chain (grad-of-sum DCEs the other kernels, so each
+number is one conv kind) — and reports achieved TFLOPS against the
+~192 TFLOPS measured device peak.  K-step lax.scan chains amortize the
+tunnel launch cost (PERF.md flash section has the methodology).
+
+Usage: python benchmarks/exp_conv.py [--steps 30] [--batch 64]
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+import common
+from common import on_tpu
+
+# (name, HW_in, Cin, Cout, k, stride, count) — ResNet-50 @ 224,
+# counts include the projection 1x1s
+SHAPES = [
+    ('stem7x7', 224, 3, 64, 7, 2, 1),
+    ('s1_1x1a', 56, 64, 64, 1, 1, 3),      # first uses Cin=64; blocks
+    ('s1_1x1a256', 56, 256, 64, 1, 1, 2),  # 2-3 read the 256-wide trunk
+    ('s1_3x3', 56, 64, 64, 3, 1, 3),
+    ('s1_1x1b', 56, 64, 256, 1, 1, 3),
+    ('s1_proj', 56, 64, 256, 1, 1, 1),
+    ('s2_1x1a', 56, 256, 128, 1, 2, 1),    # stride-2 entry
+    ('s2_1x1a512', 28, 512, 128, 1, 1, 3),
+    ('s2_3x3', 28, 128, 128, 3, 1, 4),
+    ('s2_1x1b', 28, 128, 512, 1, 1, 4),
+    ('s2_proj', 56, 256, 512, 1, 2, 1),
+    ('s3_1x1a', 28, 512, 256, 1, 2, 1),
+    ('s3_1x1a1024', 14, 1024, 256, 1, 1, 5),
+    ('s3_3x3', 14, 256, 256, 3, 1, 6),
+    ('s3_1x1b', 14, 256, 1024, 1, 1, 6),
+    ('s3_proj', 28, 512, 1024, 1, 2, 1),
+    ('s4_1x1a', 14, 1024, 512, 1, 2, 1),
+    ('s4_1x1a2048', 7, 2048, 512, 1, 1, 2),
+    ('s4_3x3', 7, 512, 512, 3, 1, 3),
+    ('s4_1x1b', 7, 512, 2048, 1, 1, 3),
+    ('s4_proj', 14, 1024, 2048, 1, 2, 1),
+]
+
+PEAK_TFLOPS = 192.0  # measured square-matmul device peak (PERF.md)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=30)
+    ap.add_argument('--batch', type=int, default=64)
+    ap.add_argument('--only', default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    tpu = on_tpu()
+    B = args.batch if tpu else 2
+    steps = args.steps if tpu else 2
+    dt = jnp.bfloat16 if tpu else jnp.float32
+    dn = lax.conv_dimension_numbers((1, 1, 1, 1), (1, 1, 1, 1),
+                                    ('NHWC', 'HWIO', 'NHWC'))
+
+    def timeit(stepfn, *state):
+        @jax.jit
+        def chain(*state):
+            def body(c, _):
+                return stepfn(*c), None
+            out, _ = jax.lax.scan(body, state, None, length=steps)
+            return out
+        cur = chain(*state)
+        np.asarray(jax.tree_util.tree_leaves(cur)[0]).ravel()[:1]
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cur = chain(*state)
+            np.asarray(jax.tree_util.tree_leaves(cur)[0]).ravel()[:1]
+            ts.append((time.perf_counter() - t0) / steps)
+        return float(np.median(ts))
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for (name, hw, cin, cout, k, stride, count) in SHAPES:
+        if args.only and args.only != name:
+            continue
+        if not tpu and hw > 56:
+            continue
+        x = jnp.asarray(rng.normal(size=(B, hw, hw, cin)) * 0.1, dt)
+        w = jnp.asarray(rng.normal(size=(k, k, cin, cout)) * 0.1, dt)
+        pad = 'SAME'
+        hwo = -(-hw // stride)
+        flops = 2 * B * hwo * hwo * cout * cin * k * k
+
+        def conv(x, w):
+            # bf16 in/out: XLA:TPU convs accumulate fp32 internally;
+            # keeping io dtypes uniform lets the vjp's transposed convs
+            # trace without cotangent-dtype mismatches
+            return lax.conv_general_dilated(
+                x, w, (stride, stride), pad, dimension_numbers=dn)
+
+        def fwd_step(x, w):
+            y = conv(x, w)
+            # scalar feedback serializes the chain without reshaping y
+            return (x * (1 + 1e-6 * jnp.mean(y).astype(dt))), w
+
+        def dgrad_step(x, w):
+            dx = jax.grad(lambda x: jnp.sum(conv(x, w)
+                                            .astype(jnp.float32)))(x)
+            return (x - 1e-6 * dx).astype(dt), w
+
+        def wgrad_step(x, w):
+            dw = jax.grad(lambda w: jnp.sum(conv(x, w)
+                                            .astype(jnp.float32)))(w)
+            return x, (w - 1e-6 * dw).astype(dt)
+
+        r = {'name': name, 'hw': hw, 'cin': cin, 'cout': cout, 'k': k,
+             'stride': stride, 'count': count,
+             'gflop': round(flops / 1e9, 2)}
+        for kind, fn in (('fwd', fwd_step), ('dgrad', dgrad_step),
+                         ('wgrad', wgrad_step)):
+            dt_s = timeit(fn, x, w)
+            r[kind + '_ms'] = round(dt_s * 1e3, 3)
+            r[kind + '_tflops'] = round(flops / dt_s / 1e12, 1)
+            r[kind + '_pct_peak'] = round(
+                100 * flops / dt_s / 1e12 / PEAK_TFLOPS, 1)
+        rows.append(r)
+        print(json.dumps(r))
+
+    tot = {'metric': 'resnet50_conv_roofline_summary', 'batch': B}
+    for kind in ('fwd', 'dgrad', 'wgrad'):
+        tot[kind + '_total_ms'] = round(
+            sum(r[kind + '_ms'] * r['count'] for r in rows), 2)
+    tot['weighted_tflops'] = round(
+        sum(r['gflop'] * r['count'] * 3 for r in rows) / 1e3 /
+        (tot['fwd_total_ms'] + tot['dgrad_total_ms'] +
+         tot['wgrad_total_ms']), 1)
+    print(json.dumps(tot))
+
+
+if __name__ == '__main__':
+    main()

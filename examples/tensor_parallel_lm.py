@@ -1,0 +1,76 @@
+"""Program-level tensor parallelism: the LM book Program's vocab head
+sharded over a 'tp' mesh axis by TensorParallelTranspiler.
+
+Runs on any machine: with fewer than 4 real devices, set
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+for a virtual 8-member mesh (what the multichip dryrun does).
+
+transpile() swaps the fused vocab head op to vocab_parallel_ce (a
+shard_map whose global logsumexp is one pmax + one psum over the tp
+axis — neither the [D, V] head nor any [N, V] logits exist on one
+chip) and column-shards the head + vocab-shards the embedding; GSPMD
+inserts the remaining collectives from the PartitionSpec plan.  The
+same transpiled Program still runs single-device (the op degrades to
+the single-chip fused head when no tp axis is bound).
+"""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import models  # noqa: E402
+from paddle_tpu.distributed import TensorParallelTranspiler  # noqa: E402
+from paddle_tpu.parallel import api  # noqa: E402
+
+VOCAB = 128
+
+
+def main():
+    if os.environ.get('JAX_PLATFORMS', '').lower() == 'cpu':
+        jax.config.update('jax_platforms', 'cpu')
+    n = len(jax.devices())
+    tp = 4 if n >= 4 else n
+    if tp < 2:
+        raise SystemExit(
+            "need >= 2 devices (hint: XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu)")
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_prog, startup):
+        src, target, avg_cost = models.rnn_lm.build(
+            VOCAB, emb_dim=32, hidden_dim=32, num_layers=1)
+        fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(
+            avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    mesh = api.make_mesh((tp,), ('tp',))
+    transpiler = TensorParallelTranspiler().transpile(
+        program=main_prog, mesh=mesh)
+    print("tp shard plan:")
+    for name, spec in sorted(transpiler.shard_plan().items()):
+        print("  %-24s %s" % (name, spec))
+    runner = transpiler.get_runner(exe)
+
+    rng = np.random.default_rng(0)
+    bs, t = 16, 8
+    for step in range(10):
+        ids = rng.integers(1, VOCAB, size=(bs, t, 1)).astype('int64')
+        tgt = rng.integers(1, VOCAB, size=(bs, t, 1)).astype('int64')
+        ln = np.full((bs,), t, np.int32)
+        loss, = runner.run(main_prog,
+                           feed={'src': (ids, ln), 'target': (tgt, ln)},
+                           fetch_list=[avg_cost])
+        print("step %d  loss %.4f" % (step, float(np.ravel(loss)[0])))
+
+
+if __name__ == '__main__':
+    main()
