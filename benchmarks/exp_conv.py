@@ -23,9 +23,17 @@ from common import on_tpu
 # counts include the projection 1x1s
 SHAPES = [
     ('stem7x7', 224, 3, 64, 7, 2, 1),
+    # MLPerf-style space-to-depth(2) stem: [224,224,3] -> [112,112,12],
+    # the 7x7/2 (zero-padded to 8x8) becomes 4x4/1 at 12 channels —
+    # same math, 4x the MXU channel occupancy, 1.3x the nominal FLOPs
+    ('stem_s2d2', 112, 12, 64, 4, 1, 0),
     ('s1_1x1a', 56, 64, 64, 1, 1, 3),      # first uses Cin=64; blocks
     ('s1_1x1a256', 56, 256, 64, 1, 1, 2),  # 2-3 read the 256-wide trunk
     ('s1_3x3', 56, 64, 64, 3, 1, 3),
+    # channel-pad probe for the worst real-path shape: same spatial
+    # geometry with Cin=128 (2x the MACs) — if it is not ~2x slower,
+    # the C=64 contraction is underfeeding the MXU
+    ('s1_3x3_c128', 56, 128, 64, 3, 1, 0),
     ('s1_1x1b', 56, 64, 256, 1, 1, 3),
     ('s1_proj', 56, 64, 256, 1, 1, 1),
     ('s2_1x1a', 56, 256, 128, 1, 2, 1),    # stride-2 entry
@@ -67,21 +75,41 @@ def main():
                                     ('NHWC', 'HWIO', 'NHWC'))
 
     def timeit(stepfn, *state):
-        @jax.jit
-        def chain(*state):
-            def body(c, _):
-                return stepfn(*c), None
-            out, _ = jax.lax.scan(body, state, None, length=steps)
-            return out
-        cur = chain(*state)
-        np.asarray(jax.tree_util.tree_leaves(cur)[0]).ravel()[:1]
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
+        """Two-chain-length fit: wall(K) = K*t_dev + L where L is the
+        ~0.1 s per-launch tunnel cost — the slope between K and 8K
+        cancels L exactly (at sub-ms conv times even K=30 leaves L
+        dominating a single-K estimate)."""
+        k1, k2 = steps, 8 * steps  # k2*t_dev must clear the ±30 ms
+        #                            tunnel wall noise, so steps >= 250
+
+        def make(k):
+            @jax.jit
+            def chain(*state):
+                def body(c, _):
+                    return stepfn(*c), None
+                out, _ = jax.lax.scan(body, state, None, length=k)
+                return out
+            return chain
+
+        def sync(cur):
+            # gather ONE scalar on-device before pulling: np.asarray on
+            # the whole carry would drag 100+ MB through the tunnel
+            leaf = jax.tree_util.tree_leaves(cur)[0]
+            np.asarray(leaf[(0,) * leaf.ndim])
+
+        walls = []
+        for k in (k1, k2):
+            chain = make(k)
             cur = chain(*state)
-            np.asarray(jax.tree_util.tree_leaves(cur)[0]).ravel()[:1]
-            ts.append((time.perf_counter() - t0) / steps)
-        return float(np.median(ts))
+            sync(cur)
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                cur = chain(*state)
+                sync(cur)
+                ts.append(time.perf_counter() - t0)
+            walls.append(float(np.median(ts)))
+        return max((walls[1] - walls[0]) / (k2 - k1), 1e-9)
 
     rng = np.random.default_rng(0)
     rows = []
@@ -103,27 +131,33 @@ def main():
             return lax.conv_general_dilated(
                 x, w, (stride, stride), pad, dimension_numbers=dn)
 
+        y0 = jnp.zeros((B, hwo, hwo, cout), dt)
+
         def fwd_step(x, w):
             y = conv(x, w)
             # scalar feedback serializes the chain without reshaping y
             return (x * (1 + 1e-6 * jnp.mean(y).astype(dt))), w
 
-        def dgrad_step(x, w):
-            dx = jax.grad(lambda x: jnp.sum(conv(x, w)
-                                            .astype(jnp.float32)))(x)
-            return (x - 1e-6 * dx).astype(dt), w
+        # dgrad/wgrad chain the COTANGENT through the previous grad: a
+        # constant cotangent makes the transposed conv loop-invariant
+        # and XLA hoists it out of the scan (measured: slope -> 0)
+        def dgrad_step(ct, x, w):
+            _, vjp = jax.vjp(lambda x: conv(x, w), x)
+            dx, = vjp(ct)
+            return (ct * (1 + 1e-6 * jnp.mean(dx).astype(dt))), x, w
 
-        def wgrad_step(x, w):
-            dw = jax.grad(lambda w: jnp.sum(conv(x, w)
-                                            .astype(jnp.float32)))(w)
-            return x, (w - 1e-6 * dw).astype(dt)
+        def wgrad_step(ct, x, w):
+            _, vjp = jax.vjp(lambda w: conv(x, w), w)
+            dw, = vjp(ct)
+            return (ct * (1 + 1e-6 * jnp.mean(dw).astype(dt))), x, w
 
         r = {'name': name, 'hw': hw, 'cin': cin, 'cout': cout, 'k': k,
              'stride': stride, 'count': count,
              'gflop': round(flops / 1e9, 2)}
-        for kind, fn in (('fwd', fwd_step), ('dgrad', dgrad_step),
-                         ('wgrad', wgrad_step)):
-            dt_s = timeit(fn, x, w)
+        for kind, fn, st in (('fwd', fwd_step, (x, w)),
+                             ('dgrad', dgrad_step, (y0 + 1, x, w)),
+                             ('wgrad', wgrad_step, (y0 + 1, x, w))):
+            dt_s = timeit(fn, *st)
             r[kind + '_ms'] = round(dt_s * 1e3, 3)
             r[kind + '_tflops'] = round(flops / dt_s / 1e12, 1)
             r[kind + '_pct_peak'] = round(
