@@ -35,7 +35,12 @@ class FeedPipeline(object):
         is preserved: worker w owns steps w, w+N, ... and pushes to its
         own ready ring; the consumer round-robins across rings, so step
         k always arrives k-th — numpy fills release the GIL, so workers
-        scale on real assembly work.
+        scale on real assembly work.  Arena blocks are PARTITIONED
+        per worker (block i belongs to worker i mod N): a shared free
+        pool can deadlock — all blocks drain into the ready rings of
+        later-order workers while the consumer waits on an earlier
+        ring whose worker has no block to fill (hit in CI; per-worker
+        ownership makes each worker's pipeline independent).
     :param stage: False yields the raw {name: ndarray} arena views
         instead of device arrays (DataFeeder-style consumers; the
         caller must be done with the views before advancing — the block
@@ -50,7 +55,6 @@ class FeedPipeline(object):
         self._fill = fill
         self._device = device
         self._workers = max(1, int(workers))
-        depth = max(depth, self._workers + 1)
         sizes = {n: int(np.prod(s)) * dt.itemsize
                  for n, (s, dt) in self._specs.items()}
         self._offsets = {}
@@ -60,14 +64,17 @@ class FeedPipeline(object):
             total = (total + 63) & ~63
             self._offsets[n] = total
             total += sizes[n]
+        # at least two blocks per worker so every worker double-buffers
+        depth = max(depth, 2 * self._workers)
         self._arena = StagingArena(block_size=max(total, 64),
                                    blocks=depth)
         self._blocks = [self._arena.acquire() for _ in range(depth)]
-        self._free = NativeQueue(depth + 1)
+        self._free = [NativeQueue(depth + 1)
+                      for _ in range(self._workers)]
         self._ready = [NativeQueue(depth + 1)
                        for _ in range(self._workers)]
         for i in range(depth):
-            self._free.push(bytes([i]))
+            self._free[i % self._workers].push(bytes([i]))
         self._threads = [
             threading.Thread(target=self._produce, args=(w,),
                              daemon=True)
@@ -88,7 +95,7 @@ class FeedPipeline(object):
     def _produce(self, worker):
         step = worker
         while True:
-            tok = self._free.pop()
+            tok = self._free[worker].pop()
             if tok is None:
                 return
             idx = tok[0]
@@ -102,7 +109,7 @@ class FeedPipeline(object):
                 self._ready[worker].close()
                 return
             if ok is False:
-                self._free.push(tok)  # unused block back to the pool
+                self._free[worker].push(tok)  # unused block back
                 self._ready[worker].close()
                 return
             self._ready[worker].push(bytes([idx]))
@@ -134,7 +141,7 @@ class FeedPipeline(object):
             if not self._stage:
                 # raw views: recycle AFTER the consumer advances
                 yield views
-                self._free.push(bytes([idx]))
+                self._free[idx % self._workers].push(bytes([idx]))
                 k += 1
                 continue
             if aliases_host:
@@ -147,11 +154,12 @@ class FeedPipeline(object):
             else:
                 feed = {n: jax.device_put(v, dev) for n, v in views.items()}
                 jax.block_until_ready(list(feed.values()))
-            self._free.push(bytes([idx]))
+            self._free[idx % self._workers].push(bytes([idx]))
             k += 1
             yield feed
 
     def close(self):
-        self._free.close()
+        for q in self._free:
+            q.close()
         for q in self._ready:
             q.close()
