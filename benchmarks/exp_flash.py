@@ -43,7 +43,9 @@ def main():
     ap.add_argument('--T', type=int, default=8192)
     ap.add_argument('--H', type=int, default=8)
     ap.add_argument('--D', type=int, default=64)
-    ap.add_argument('--steps', type=int, default=10)
+    # 100-step chains: short chains fold the ~0.1 s per-launch tunnel
+    # cost into every step (PERF.md flash-roofline methodology)
+    ap.add_argument('--steps', type=int, default=100)
     ap.add_argument('--causal', type=int, default=1)
     args = ap.parse_args()
 
