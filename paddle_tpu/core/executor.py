@@ -25,6 +25,39 @@ __all__ = ['Executor', 'global_scope', 'scope_guard']
 
 from .scope import scope_guard  # re-export (parity with fluid.executor)
 
+_compilation_cache_dir = None  # last dir applied to jax.config
+
+
+def _maybe_enable_compilation_cache():
+    """Opt-in persistent XLA compilation cache
+    (PADDLE_TPU_COMPILATION_CACHE_DIR): every jit compile — Executor
+    plans, serving warmup buckets — lands in this directory and survives
+    process restarts, so a restarted server skips straight to cache hits.
+    Re-reads the flag each call (cheap) so tests and long-lived drivers
+    can flip it; thresholds drop to 0 so even fast CPU-smoke compiles
+    persist (the default 1s floor would skip them silently)."""
+    global _compilation_cache_dir
+    from ..flags import FLAGS
+    d = FLAGS.compilation_cache_dir or None
+    if d == _compilation_cache_dir:
+        return
+    try:
+        jax.config.update('jax_compilation_cache_dir', d)
+        if d:
+            jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                              0.0)
+            jax.config.update('jax_persistent_cache_min_entry_size_bytes',
+                              0)
+        # jax latches the cache backend at its first compile; flipping
+        # the dir after that is silently ignored unless the cache is
+        # reset, so a long-lived process (or test) can opt in late
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - older jax without the knobs
+        return
+    _compilation_cache_dir = d
+
 
 class ExecutionContext(object):
     """Per-trace context handed to op compute functions: PRNG derivation,
@@ -371,6 +404,7 @@ class Executor(object):
         if isinstance(place, (list, tuple)):
             place = place[0]
         self.place = place if place is not None else default_place()
+        _maybe_enable_compilation_cache()
         self._cache = {}
         self._mesh_op_cache = {}
         self._step = 0
@@ -522,10 +556,12 @@ class Executor(object):
         state_rw_names, state_ro_names, state_out_names = \
             self._analyze_state(program, scope, set(feed_arrays))
         # mesh participates: a parallel_do program traced under a mesh
-        # embeds that mesh's shard_map in the compiled step
+        # embeds that mesh's shard_map in the compiled step.  Scope
+        # identity is its monotonic _uid, never id(): ids recycle after
+        # gc and would alias a fresh scope's plans with a dead one's.
         key = (program._uid, program.version, feed_sig, fetch_names,
-               state_rw_names, state_ro_names, state_out_names, id(scope),
-               mesh)
+               state_rw_names, state_ro_names, state_out_names,
+               scope._uid, mesh)
         if use_cache and key in self._cache:
             return self._cache[key]
 
@@ -633,7 +669,7 @@ class Executor(object):
         mkey = ('multi', program._uid, program.version, k, stacked,
                 fetch_names,
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
-                      for n in sorted(feed0)), id(scope),
+                      for n in sorted(feed0)), scope._uid,
                 rw_names, ro_names, mesh)
         multi = self._cache.get(mkey)
         if multi is None:

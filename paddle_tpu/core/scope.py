@@ -4,11 +4,19 @@ Reference parity: paddle/framework/scope.{h,cc}.  Values are jax.Arrays that
 stay resident on device between Executor.run calls (parameters, optimizer
 moments, batch-norm running stats, global step, RNG state).
 """
+import itertools
+
 import numpy as np
+
+# Monotonic scope identity for plan-cache keys: id(scope) can be reused by
+# the allocator after a scope is garbage-collected, silently aliasing a new
+# scope's compiled plans (and donated-state signatures) with a dead one's.
+_scope_uid = itertools.count()
 
 
 class Scope(object):
     def __init__(self, parent=None):
+        self._uid = next(_scope_uid)
         self._vars = {}
         self.parent = parent
         self._kids = []

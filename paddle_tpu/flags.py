@@ -71,3 +71,12 @@ DEFINE_string('profile_dir', '/tmp/paddle_tpu_prof',
               'where profiler traces are written')
 DEFINE_bool('use_native_runtime', True,
             'use the C++ dataio prefetcher when the extension builds')
+DEFINE_string('compilation_cache_dir', '',
+              'opt-in persistent XLA compilation cache directory: compiled '
+              'executables (Executor plans, serving warmup buckets) are '
+              'written here and reloaded across process restarts, turning '
+              'multi-second XLA compiles into disk reads.  Empty disables. '
+              'Caveats: entries key on jax/XLA version + topology, so a '
+              'toolchain upgrade silently recompiles; the cache grows '
+              'unboundedly (prune externally); and a shared dir must live '
+              'on a filesystem with atomic renames')

@@ -1,3 +1,6 @@
 from .serving import export_inference, load_exported, InferenceServer
+from .batching import (BatchingInferenceServer, bucket_sizes,
+                       export_bucketed)
 
-__all__ = ['export_inference', 'load_exported', 'InferenceServer']
+__all__ = ['export_inference', 'load_exported', 'InferenceServer',
+           'BatchingInferenceServer', 'export_bucketed', 'bucket_sizes']

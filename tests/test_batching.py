@@ -1,0 +1,321 @@
+"""N4+ — dynamic request batching over the shape-bucketed serving runtime.
+
+Covers the BatchingInferenceServer contract: bucket selection, pad-mask
+correctness (padding never leaks into real outputs), the deadline flush
+for a lone request, concurrent submits, and warmup precompiling every
+bucket (zero compiles inside the serving loop, by counter).
+"""
+import threading
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (BatchingInferenceServer, InferenceServer,
+                                  bucket_sizes, export_bucketed)
+from paddle_tpu.inference.batching import _Request
+
+MAX_BATCH = 8
+
+
+@pytest.fixture(scope='module')
+def bucket_paths(tmp_path_factory):
+    """Export the bucket ladder for a small logits MLP once per module
+    (exports + warmup compiles dominate test wall time)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        pred = fluid.layers.fc(input=h, size=4)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    d = tmp_path_factory.mktemp('buckets')
+    return export_bucketed(str(d), {'x': (6,)}, [pred], executor=exe,
+                           main_program=main, scope=scope,
+                           max_batch=MAX_BATCH)
+
+
+@pytest.fixture(scope='module')
+def server(bucket_paths):
+    srv = BatchingInferenceServer(bucket_paths, max_wait_ms=50.0,
+                                  linger_ms=2.0)
+    yield srv
+    srv.close()
+
+
+@pytest.fixture(scope='module')
+def ref1(bucket_paths):
+    """Unbatched single-row reference server (bucket-1 artifact)."""
+    return InferenceServer(bucket_paths[1])
+
+
+def _feed(rng, rows=None):
+    shape = (6,) if rows is None else (rows, 6)
+    return {'x': rng.randn(*shape).astype('float32')}
+
+
+def test_bucket_sizes_ladder():
+    assert bucket_sizes(1) == [1]
+    assert bucket_sizes(8) == [1, 2, 4, 8]
+    assert bucket_sizes(6) == [1, 2, 4, 8]  # rounds up
+    with pytest.raises(ValueError):
+        bucket_sizes(0)
+
+
+def test_bucket_selection(server):
+    assert [server._bucket_for(r) for r in (1, 2, 3, 5, 8)] == \
+        [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        server._bucket_for(MAX_BATCH + 1)
+
+
+def test_assemble_offsets_and_padding(server):
+    rng = np.random.RandomState(0)
+    reqs = []
+    for rows in (1, 2, 1):
+        norm, k = server._normalize(_feed(rng, rows))
+        reqs.append(_Request(norm, k, 0.0))
+    bucket, stacked, offsets = server._assemble(reqs)
+    assert bucket == 4
+    assert offsets == [(0, 1), (1, 3), (3, 4)]
+    assert stacked['x'].shape == (4, 6)
+    # rows land in submission order, no padding needed (4 rows == bucket)
+    np.testing.assert_array_equal(stacked['x'][0], reqs[0].feed['x'][0])
+    np.testing.assert_array_equal(stacked['x'][1:3], reqs[1].feed['x'])
+    np.testing.assert_array_equal(stacked['x'][3], reqs[2].feed['x'][0])
+
+    # 3 rows into bucket 4: the pad row replicates the last real row
+    bucket, stacked, offsets = server._assemble(reqs[:2])
+    assert bucket == 4 and offsets == [(0, 1), (1, 3)]
+    np.testing.assert_array_equal(stacked['x'][3], stacked['x'][2])
+
+
+def test_padded_rows_never_leak(server, bucket_paths):
+    """Real rows are bitwise independent of pad content: a 5-row request
+    (padded to bucket 8) returns exactly the first 5 rows of a full
+    8-row run whose trailing rows hold unrelated data."""
+    rng = np.random.RandomState(1)
+    x5 = rng.randn(5, 6).astype('float32')
+    got, = server.predict({'x': x5})
+    assert got.shape == (5, 4)
+    s8 = InferenceServer(bucket_paths[8])
+    garbage = rng.randn(3, 6).astype('float32') * 100.0
+    full, = s8.predict({'x': np.concatenate([x5, garbage])})
+    np.testing.assert_array_equal(got, np.asarray(full)[:5])
+
+
+def test_bucket_exact_request_bitwise_matches_unbatched(server,
+                                                        bucket_paths):
+    """A request that exactly fills its bucket runs the same program on
+    the same rows as an unbatched predict on that bucket's artifact —
+    bit-identical, not just close."""
+    rng = np.random.RandomState(2)
+    for rows in (1, 2, 4, 8):
+        x = rng.randn(rows, 6).astype('float32')
+        got, = server.predict({'x': x})
+        want, = InferenceServer(bucket_paths[rows]).predict({'x': x})
+        np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_single_row_request_matches_unbatched(server, ref1):
+    """Cross-bucket routing stays numerically faithful to the unbatched
+    single-row path (allclose: XLA may pick ulp-different kernels for
+    different batch shapes — see the batching module docstring)."""
+    rng = np.random.RandomState(3)
+    f = _feed(rng)
+    got, = server.predict(f)
+    want, = ref1.predict({'x': f['x'][None]})
+    assert got.shape == (1, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_deadline_flush_fires_for_lone_request(bucket_paths):
+    """A lone request must not wait for a full batch: with nothing else
+    queued it completes within ~linger/max_wait, not a test timeout."""
+    srv = BatchingInferenceServer(bucket_paths, max_wait_ms=40.0,
+                                  linger_ms=1.0)
+    try:
+        rng = np.random.RandomState(4)
+        t0 = time.perf_counter()
+        fut = srv.submit(_feed(rng))
+        out, = fut.result(timeout=5.0)
+        elapsed = time.perf_counter() - t0
+        assert out.shape == (1, 4)
+        assert elapsed < 2.0  # flushed by linger/deadline, not stuck
+        st = srv.stats()
+        assert st['batches'] == 1
+        assert st['requests_completed'] == 1
+        assert st['mean_batch_occupancy'] == 1
+    finally:
+        srv.close()
+
+
+def test_concurrent_submits_all_get_their_own_result(server, ref1):
+    n_threads, per_thread = 6, 10
+    rng = np.random.RandomState(5)
+    feeds = [[_feed(rng) for _ in range(per_thread)]
+             for _ in range(n_threads)]
+    results = [[None] * per_thread for _ in range(n_threads)]
+    errors = []
+
+    def client(i):
+        try:
+            for j in range(per_thread):
+                results[i][j] = server.predict(feeds[i][j],
+                                               timeout=30.0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    before = server.stats()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    after = server.stats()
+    done = after['requests_completed'] - before['requests_completed']
+    assert done == n_threads * per_thread
+    for i in range(n_threads):
+        for j in range(per_thread):
+            want, = ref1.predict({'x': feeds[i][j]['x'][None]})
+            np.testing.assert_allclose(results[i][j][0], want,
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_warmup_precompiles_every_bucket_and_loop_never_compiles(
+        server):
+    st = server.stats()
+    assert st['buckets'] == [1, 2, 4, 8]
+    assert st['compiles'] == len(st['buckets'])
+    assert st['compiles_after_warmup'] == 0
+    # drive traffic through every bucket size, then recheck
+    rng = np.random.RandomState(6)
+    for rows in (1, 2, 3, 5, 8):
+        server.predict(_feed(rng, rows), timeout=30.0)
+    assert server.stats()['compiles_after_warmup'] == 0
+    assert server.stats()['compiles'] == len(st['buckets'])
+
+
+def test_no_warmup_counts_on_demand_compiles(bucket_paths):
+    srv = BatchingInferenceServer(bucket_paths, warmup=False,
+                                  max_wait_ms=40.0, linger_ms=1.0)
+    try:
+        assert srv.stats()['compiles'] == 0
+        rng = np.random.RandomState(7)
+        srv.predict(_feed(rng), timeout=30.0)
+        st = srv.stats()
+        assert st['compiles'] == 1
+        assert st['compiles_after_warmup'] == 1  # the counted stall
+    finally:
+        srv.close()
+
+
+def test_request_validation(server):
+    rng = np.random.RandomState(8)
+    with pytest.raises(ValueError):
+        server.submit({'y': np.zeros((6,), np.float32)})  # wrong name
+    with pytest.raises(ValueError):
+        server.submit({'x': np.zeros((7,), np.float32)})  # wrong shape
+    with pytest.raises(ValueError):
+        server.submit(_feed(rng, MAX_BATCH + 1))  # too many rows
+
+
+def test_close_rejects_new_requests(bucket_paths):
+    srv = BatchingInferenceServer(bucket_paths, warmup=False)
+    srv.close()
+    with pytest.raises(RuntimeError):
+        srv.submit({'x': np.zeros((6,), np.float32)})
+
+
+@pytest.mark.slow
+def test_throughput_acceptance_ctr_style():
+    """Acceptance sketch on the CPU smoke config: a many-field (CTR-ish)
+    tower at concurrency 8 through the batcher vs sequential unbatched
+    predict.  Medians over paired trials; the threshold here is kept
+    conservative (the bench_serving `dynamic` scenario reports the real
+    numbers — ≥3x on a quiet box)."""
+    ns = 12
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        embs = []
+        for i in range(ns):
+            c = fluid.layers.data(name='C%d' % i, shape=[1],
+                                  dtype='int64')
+            embs.append(fluid.layers.embedding(input=c,
+                                               size=[1000, 16]))
+        dense = fluid.layers.data(name='I', shape=[13],
+                                  dtype='float32')
+        feat = fluid.layers.concat(embs + [dense], axis=1)
+        h = fluid.layers.fc(input=feat, size=128, act='relu')
+        pred = fluid.layers.fc(input=h, size=1, act='sigmoid')
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    specs = {('C%d' % i): (1,) for i in range(ns)}
+    specs['I'] = (13,)
+    srv = BatchingInferenceServer.from_program(
+        specs, [pred], executor=exe, main_program=main, scope=scope,
+        max_batch=64, max_wait_ms=10.0, linger_ms=0.3)
+    ref = srv._servers[1]  # the unbatched single-row artifact
+    rng = np.random.RandomState(0)
+
+    def mk():
+        f = {('C%d' % i):
+             rng.randint(0, 1000, size=(1, 1)).astype('int32')
+             for i in range(ns)}
+        f['I'] = rng.randn(1, 13).astype('float32')
+        return f
+
+    f1 = mk()
+    ref.predict(f1)
+    for _ in range(50):
+        srv.submit(f1)
+    srv.predict(f1)
+
+    def base_rate(n=100):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ref.predict(f1)
+        return n / (time.perf_counter() - t0)
+
+    def batched_rate(n_threads=8, depth=8, total=320):
+        per = total // n_threads
+        feeds = [[mk() for _ in range(per)] for _ in range(n_threads)]
+
+        def client(i):
+            q = deque()
+            for j in range(per):
+                q.append(srv.submit(feeds[i][j]))
+                while len(q) >= depth:
+                    q.popleft().result(timeout=60.0)
+            while q:
+                q.popleft().result(timeout=60.0)
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return total / (time.perf_counter() - t0)
+
+    ratios = []
+    for _ in range(3):
+        b = base_rate()
+        r = batched_rate()
+        ratios.append(r / b)
+    st = srv.stats()
+    srv.close()
+    assert st['compiles_after_warmup'] == 0
+    assert st['mean_batch_occupancy'] > 2
+    assert float(np.median(ratios)) >= 1.5, ratios

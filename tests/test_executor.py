@@ -255,3 +255,86 @@ def test_run_steps_out_only_state_single_copy():
     exe.run_steps(main, feed=batches, fetch_list=[loss])
     got = np.asarray(fluid.global_scope().find_var(snap.name))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_plan_cache_keys_on_scope_uid_not_id():
+    """Plan-cache scope identity is a monotonic uid: id() reuse after gc
+    must not alias a new scope's plans with a dead scope's."""
+    import gc
+
+    import paddle_tpu as fluid
+
+    s1 = fluid.Scope()
+    s2 = fluid.Scope()
+    assert s1._uid != s2._uid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {'x': np.ones((2, 3), np.float32)}
+
+    scope_a = fluid.Scope()
+    exe.run(startup, scope=scope_a)
+    exe.run(main, feed=feed, fetch_list=[y], scope=scope_a)
+    n_after_a = len(exe._cache)
+    uid_a = scope_a._uid
+    del scope_a
+    gc.collect()
+
+    scope_b = fluid.Scope()
+    assert scope_b._uid != uid_a
+    exe.run(startup, scope=scope_b)
+    exe.run(main, feed=feed, fetch_list=[y], scope=scope_b)
+    # a fresh scope compiles fresh plans instead of aliasing the dead
+    # scope's entries
+    assert len(exe._cache) > n_after_a
+
+
+def test_use_program_cache_false_bypasses_insertion():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, use_program_cache=False)
+    feed = {'x': np.ones((2, 3), np.float32)}
+    out1, = exe.run(main, feed=feed, fetch_list=[y],
+                    use_program_cache=False)
+    assert exe._cache == {}
+    out2, = exe.run(main, feed=feed, fetch_list=[y])
+    assert len(exe._cache) == 1
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_persistent_compilation_cache_flag(tmp_path, monkeypatch):
+    """PADDLE_TPU_COMPILATION_CACHE_DIR wires jax's persistent
+    compilation cache: compiled executables land on disk and survive a
+    process restart."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core import executor as executor_mod
+
+    cache_dir = tmp_path / 'xla_cache'
+    monkeypatch.setenv('PADDLE_TPU_COMPILATION_CACHE_DIR',
+                       str(cache_dir))
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+            y = fluid.layers.fc(input=x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())  # applies the flag
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+        exe.run(startup)
+        exe.run(main, feed={'x': np.ones((2, 3), np.float32)},
+                fetch_list=[y])
+        assert cache_dir.exists() and any(cache_dir.iterdir())
+    finally:
+        monkeypatch.delenv('PADDLE_TPU_COMPILATION_CACHE_DIR',
+                           raising=False)
+        executor_mod._maybe_enable_compilation_cache()  # back to off
+        assert jax.config.jax_compilation_cache_dir is None

@@ -69,3 +69,99 @@ def test_predict_many_and_async_match_predict(tmp_path):
         np.testing.assert_allclose(np.asarray(outs[0]), w, rtol=1e-6)
 
     assert server.predict_many([]) == []
+
+
+def test_example_args_honour_declared_dtypes():
+    """Satellite fix: export example feeds trace at each var's DECLARED
+    dtype (bf16/bool/int), narrowed to device width — not the old
+    float32-unless-'int' heuristic that exported f32 artifacts for bf16
+    feed vars."""
+    import ml_dtypes
+
+    from paddle_tpu.inference.serving import _example_args
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.layers.data(name='xb', shape=[4], dtype='bfloat16')
+        fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        fluid.layers.data(name='mask', shape=[4], dtype='bool')
+        fluid.layers.data(name='xf', shape=[4], dtype='float32')
+    shapes = {'xb': (2, 4), 'ids': (2, 1), 'mask': (2, 4),
+              'xf': (2, 4), 'unknown': (2, 3)}
+    out = _example_args(main, shapes)
+    assert out['xb'].dtype == ml_dtypes.bfloat16
+    assert out['ids'].dtype == np.int32  # int64 narrows (x64 disabled)
+    assert out['mask'].dtype == np.bool_
+    assert out['xf'].dtype == np.float32
+    assert out['unknown'].dtype == np.float32  # fallback
+    for name, shape in shapes.items():
+        assert out[name].shape == shape
+
+
+def test_bf16_feed_var_exports_bf16_artifact(tmp_path):
+    """End to end: a bfloat16 feed var produces a bf16-specialized
+    artifact (the old heuristic silently exported f32)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='bfloat16')
+        pred = fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / 'bf16.stablehlo')
+    export_inference(path, {'x': (2, 4)}, [pred], executor=exe,
+                     main_program=main)
+    server = InferenceServer(path)
+    avals = server.feed_avals()
+    assert str(avals['x'].dtype) == 'bfloat16'
+    assert avals['x'].shape == (2, 4)
+
+
+def test_predict_many_passes_device_arrays_through(tmp_path):
+    """Satellite fix: device-resident feed values must not round-trip
+    device->host->device; predict_many stacks them on device and the
+    results still match the host-array path."""
+    import jax
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 6
+    startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[5], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='tanh')
+        pred = fluid.layers.fc(input=h, size=4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / 'm.stablehlo')
+    export_inference(path, {'x': (2, 5)}, [pred], executor=exe,
+                     main_program=main)
+    server = InferenceServer(path)
+
+    rng = np.random.RandomState(2)
+    host_feeds = [{'x': rng.randn(2, 5).astype('float32')}
+                  for _ in range(3)]
+    want = server.predict_many(host_feeds)
+
+    device_feeds = [{'x': jax.device_put(f['x'])} for f in host_feeds]
+    orig_asarray = np.asarray
+    dragged = []
+
+    def spy_asarray(a, *args, **kw):
+        if isinstance(a, jax.Array):
+            dragged.append(a)
+        return orig_asarray(a, *args, **kw)
+
+    np.asarray = spy_asarray
+    try:
+        got = server.predict_many(device_feeds)
+    finally:
+        np.asarray = orig_asarray
+    # the stacking path never np.asarray'd a device array; the only
+    # device->host sync is the final fetch of the one stacked output
+    assert len(dragged) == 1
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(g[0], w[0], rtol=1e-6)
