@@ -187,6 +187,15 @@ def main():
         print(json.dumps(r))
         results.append(r)
     results.extend(dynamic_scenario(tpu))
+    # attach the observability snapshot so BENCH_*.json runs carry the
+    # queue/occupancy/latency telemetry behind the headline numbers
+    # (empty when PADDLE_TPU_METRICS_ENABLED=0 — servers then report to
+    # private registries)
+    from paddle_tpu import observability
+    snap = {"metric": "serving_metrics_snapshot",
+            "snapshot": observability.snapshot()}
+    print(json.dumps(snap))
+    results.append(snap)
     return results
 
 

@@ -52,6 +52,7 @@ from . import highlevel  # v2 trainer/event/parameters/inference (V5-V7)
 from . import plot  # v2 notebook training-curve Ploter
 from . import flags  # A5 env-var config registry
 from .flags import FLAGS
+from . import observability  # metrics registry + /metrics exposition
 from . import debug  # A3 nan/inf guards
 from . import transpiler  # P14 memory_optimize -> remat
 from .transpiler import memory_optimize, release_memory
@@ -66,6 +67,7 @@ __all__ = [
     'core', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
     'regularizer', 'learning_rate_decay', 'clip', 'evaluator', 'io',
     'profiler', 'reader', 'datasets', 'dataset', 'batch',
+    'observability',
     'parallel', 'distributed', 'DistributeTranspiler',
     'SimpleDistributeTranspiler',
     'Executor', 'Program', 'Block', 'Operator', 'Variable', 'Parameter',

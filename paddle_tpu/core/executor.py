@@ -9,11 +9,14 @@ for the MXU; parameters stay device-resident in the Scope and are donated
 across steps, so a full train step (forward + backward + optimizer update)
 is one device launch with zero host round-trips.
 """
+import time
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from . import datatypes
 from .lod import LoDTensor
 from .place import default_place
@@ -57,6 +60,68 @@ def _maybe_enable_compilation_cache():
     except Exception:  # pragma: no cover - older jax without the knobs
         return
     _compilation_cache_dir = d
+
+
+class _ExecutorMetrics(object):
+    """Handles into the observability registry for the executor layer.
+
+    Created lazily on the first *enabled* use — with
+    PADDLE_TPU_METRICS_ENABLED=0 nothing here is ever allocated, which
+    is the zero-overhead contract the hot path relies on.  All metrics
+    are host-side: they bracket the calls *into* compiled code, never
+    run under a trace.
+    """
+
+    def __init__(self):
+        r = _obs.registry()
+        # .child() handles: one lock per event on the hot path, vs the
+        # metric-level conveniences' label lookup + two locks per event
+        self.plan_cache_hits = r.counter(
+            'paddle_tpu_executor_plan_cache_hits_total',
+            'Executor plan-cache lookups served from cache').child()
+        self.plan_cache_misses = r.counter(
+            'paddle_tpu_executor_plan_cache_misses_total',
+            'Executor plan-cache lookups that built (traced) a new '
+            'plan').child()
+        self.compiles = r.counter(
+            'paddle_tpu_executor_compiles_total',
+            'first invocations of freshly built plans (each pays the '
+            'XLA compile)').child()
+        self.compile_seconds = r.histogram(
+            'paddle_tpu_executor_compile_seconds',
+            'wall time of the first invocation of a fresh plan '
+            '(trace + XLA compile + dispatch)',
+            buckets=_obs.DEFAULT_COMPILE_BUCKETS).child()
+        self.runs = r.counter(
+            'paddle_tpu_executor_runs_total',
+            'Executor.run() calls').child()
+        self.steps = r.counter(
+            'paddle_tpu_executor_steps_total',
+            'train/eval steps executed (run() counts one, '
+            'run_steps(K) counts K)').child()
+        self.feed_bytes = r.counter(
+            'paddle_tpu_executor_feed_bytes_total',
+            'bytes of feed data staged to the device').child()
+        self.donated_state_bytes = r.counter(
+            'paddle_tpu_executor_donated_state_bytes_total',
+            'bytes of persistable state donated into compiled '
+            'steps').child()
+
+
+_exec_metrics = None
+
+
+def _em():
+    global _exec_metrics
+    if _exec_metrics is None:
+        _exec_metrics = _ExecutorMetrics()
+    return _exec_metrics
+
+
+def _nbytes(arrays):
+    """Total nbytes over a {name: array} dict (jax and numpy arrays both
+    expose .nbytes; anything else counts 0)."""
+    return sum(getattr(v, 'nbytes', 0) for v in arrays.values())
 
 
 class ExecutionContext(object):
@@ -408,6 +473,7 @@ class Executor(object):
         self._cache = {}
         self._mesh_op_cache = {}
         self._step = 0
+        self._plan_fresh = False  # set by _get_plan, read by run()
 
     # ------------------------------------------------------------------
     def run(self,
@@ -453,12 +519,34 @@ class Executor(object):
         rng_key = jax.device_put(self._rng_key(program), dev)
         self._step += 1
 
-        fetches, new_state = fn(feed_arrays, state_rw, state_ro, rng_key)
+        em = _em() if _obs.enabled() else None
+        if em is not None:
+            em.runs.inc()
+            em.steps.inc()
+            em.feed_bytes.inc(_nbytes(feed_arrays))
+            em.donated_state_bytes.inc(_nbytes(state_rw))
 
-        for n, v in new_state.items():
-            scope.set(n, v)
-        if return_numpy:
-            fetches = [np.asarray(v) for v in fetches]
+        # the span covers dispatch + scope update + (for return_numpy)
+        # the host sync, so its histogram reads as per-call latency
+        with _obs.span('executor.run'):
+            if em is not None and self._plan_fresh:
+                # first invocation of a fresh plan: jit compiles
+                # synchronously inside this call.  The inner span also
+                # lands "executor.compile" on any running XLA trace
+                self._plan_fresh = False
+                with _obs.span('executor.compile'):
+                    t0 = time.perf_counter()
+                    fetches, new_state = fn(feed_arrays, state_rw,
+                                            state_ro, rng_key)
+                    em.compile_seconds.observe(time.perf_counter() - t0)
+                em.compiles.inc()
+            else:
+                fetches, new_state = fn(feed_arrays, state_rw,
+                                        state_ro, rng_key)
+            for n, v in new_state.items():
+                scope.set(n, v)
+            if return_numpy:
+                fetches = [np.asarray(v) for v in fetches]
         return fetches
 
     # ------------------------------------------------------------------
@@ -563,7 +651,18 @@ class Executor(object):
                state_rw_names, state_ro_names, state_out_names,
                scope._uid, mesh)
         if use_cache and key in self._cache:
+            self._plan_fresh = False
+            if _obs.enabled():
+                _em().plan_cache_hits.inc()
             return self._cache[key]
+        # the caller (run) reads this flag to time the plan's first
+        # invocation — the call that pays the XLA compile.  The jitted
+        # fn itself stays a bare jax.jit object: wrapping it would break
+        # the AOT consumers of compile() (fn.lower().compile()), and the
+        # export path would fire a wrapper's timer mid-trace
+        self._plan_fresh = True
+        if _obs.enabled():
+            _em().plan_cache_misses.inc()
 
         known = set()
         for b in program.blocks:
@@ -672,10 +771,15 @@ class Executor(object):
                       for n in sorted(feed0)), scope._uid,
                 rw_names, ro_names, mesh)
         multi = self._cache.get(mkey)
-        if multi is None:
+        multi_fresh = multi is None
+        if multi_fresh:
+            if _obs.enabled():
+                _em().plan_cache_misses.inc()
             multi = jax.jit(make_multi_step_fn(raw_fn, stacked, k),
                             donate_argnums=(2,))
             self._cache[mkey] = multi
+        elif _obs.enabled():
+            _em().plan_cache_hits.inc()
 
         xs = None
         if stacked:
@@ -698,16 +802,31 @@ class Executor(object):
             jax.random.PRNGKey(self._base_seed(program)), dev)
         t0 = jnp.asarray(self._step, jnp.int32)
 
-        ys, rw_f, last_extra = multi(feed0, xs, state_rw, state_ro,
-                                     key0, t0)
-        self._step += k
-        for n, v in rw_f.items():
-            scope.set(n, v)
-        for n, v in last_extra.items():
-            scope.set(n, v)
-        if return_numpy:
-            return [np.asarray(y) for y in ys]
-        return list(ys)
+        em = _em() if _obs.enabled() else None
+        if em is not None:
+            em.steps.inc(k)
+            em.feed_bytes.inc(_nbytes(feed0) + (_nbytes(xs) if xs else 0))
+            em.donated_state_bytes.inc(_nbytes(state_rw))
+
+        with _obs.span('executor.run_steps'):
+            if em is not None and multi_fresh:
+                with _obs.span('executor.compile'):
+                    tc = time.perf_counter()
+                    ys, rw_f, last_extra = multi(feed0, xs, state_rw,
+                                                 state_ro, key0, t0)
+                    em.compile_seconds.observe(time.perf_counter() - tc)
+                em.compiles.inc()
+            else:
+                ys, rw_f, last_extra = multi(feed0, xs, state_rw,
+                                             state_ro, key0, t0)
+            self._step += k
+            for n, v in rw_f.items():
+                scope.set(n, v)
+            for n, v in last_extra.items():
+                scope.set(n, v)
+            if return_numpy:
+                return [np.asarray(y) for y in ys]
+            return list(ys)
 
     def _compile_common(self, program, feed, fetch_list, scope):
         if program is None:
@@ -753,5 +872,6 @@ class Executor(object):
 
     def close(self):
         self._cache.clear()
+        self._mesh_op_cache.clear()
         if hasattr(self, '_sharded_cache'):
             self._sharded_cache.clear()

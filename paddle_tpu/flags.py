@@ -71,6 +71,28 @@ DEFINE_string('profile_dir', '/tmp/paddle_tpu_prof',
               'where profiler traces are written')
 DEFINE_bool('use_native_runtime', True,
             'use the C++ dataio prefetcher when the extension builds')
+DEFINE_bool('metrics_enabled', True,
+            'arm the observability registry (paddle_tpu.observability): '
+            'executor plan-cache/compile counters, serving queue/latency '
+            'histograms, reader sample counters, and span() timings.  '
+            '0 disables every instrumented path at one cached-bool cost '
+            '(no registry allocation on the executor hot path)')
+DEFINE_int('metrics_port', 0,
+           'when >0, serving runtimes expose GET /metrics (Prometheus '
+           'text exposition 0.0.4) and /healthz on this port via a '
+           'stdlib daemon-thread HTTP server '
+           '(observability.serve_metrics / maybe_serve_from_env).  '
+           '0 (default) serves nothing')
+DEFINE_string('metrics_host', '127.0.0.1',
+              'bind address for the /metrics endpoint.  Defaults to '
+              'loopback — the listener is unauthenticated, so binding '
+              'wider (0.0.0.0 for a scrape sidecar/k8s probe) is a '
+              'deliberate choice, not the default')
+DEFINE_int('profiler_event_cap', 10000,
+           'max RecordEvent/profile-region entries the profiler retains '
+           '(deque maxlen; oldest drop first) so long-lived serving '
+           'processes using RecordEvent do not leak memory.  <=0 means '
+           'unbounded; takes effect at import or on reset_profiler()')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '
@@ -80,3 +102,9 @@ DEFINE_string('compilation_cache_dir', '',
               'toolchain upgrade silently recompiles; the cache grows '
               'unboundedly (prune externally); and a shared dir must live '
               'on a filesystem with atomic renames')
+
+
+if __name__ == '__main__':
+    # `python -m paddle_tpu.flags`: print every declared flag with its
+    # env var name, default, and help string
+    print(FLAGS.help())

@@ -41,6 +41,7 @@ bucket program results are deterministic, and a request that exactly
 fills its bucket is bit-identical to an unbatched ``predict`` on that
 bucket's artifact.
 """
+import itertools
 import os
 import queue
 import tempfile
@@ -53,12 +54,82 @@ import numpy as np
 
 import jax
 
+from .. import observability as _obs
 from ..core.executor import _maybe_enable_compilation_cache
 from .serving import InferenceServer, export_inference
 
 __all__ = ['BatchingInferenceServer', 'export_bucketed', 'bucket_sizes']
 
 _STOP = object()
+
+_server_seq = itertools.count()
+
+
+class _ServingMetrics(object):
+    """Per-server handles into a metrics registry, labeled
+    ``server="b<N>"`` so concurrent servers in one process stay
+    distinguishable on /metrics while ``stats()`` reads back exactly
+    this server's children.
+
+    When observability is disabled the server still needs its counters —
+    ``stats()`` is part of the serving contract — so it reports into a
+    private registry instead of the global one: same code path, nothing
+    exported, nothing shared.
+    """
+
+    def __init__(self, reg, sid):
+        L = ('server',)
+        self._sid = sid
+        self._families = []
+
+        def child(metric):
+            self._families.append(metric)
+            return metric.labels(server=sid)
+
+        self.submitted = child(reg.counter(
+            'paddle_tpu_serving_requests_submitted_total',
+            'requests accepted by submit()', L))
+        self.completed = child(reg.counter(
+            'paddle_tpu_serving_requests_completed_total',
+            'requests whose results were delivered', L))
+        self.batches = child(reg.counter(
+            'paddle_tpu_serving_batches_total',
+            'device batches dispatched', L))
+        self.batch_rows = child(reg.counter(
+            'paddle_tpu_serving_batch_rows_total',
+            'real (non-padding) rows dispatched in batches', L))
+        self.batch_capacity = child(reg.counter(
+            'paddle_tpu_serving_batch_capacity_total',
+            'bucket capacity dispatched (rows incl. padding)', L))
+        self.compiles = child(reg.counter(
+            'paddle_tpu_serving_compiles_total',
+            'bucket AOT compiles (warmup + on-demand)', L))
+        self.compiles_after_warmup = child(reg.counter(
+            'paddle_tpu_serving_compiles_after_warmup_total',
+            'compiles after warmup finished — nonzero means the bucket '
+            'ladder missed a shape and the loop stalled', L))
+        self.queue_depth = child(reg.gauge(
+            'paddle_tpu_serving_queue_depth',
+            'requests waiting to be batched', L))
+        self.in_flight = child(reg.gauge(
+            'paddle_tpu_serving_in_flight_batches',
+            'batches dispatched but not yet synced', L))
+        self.latency = child(reg.histogram(
+            'paddle_tpu_serving_request_latency_seconds',
+            'submit-to-result latency per request', L,
+            buckets=_obs.DEFAULT_LATENCY_BUCKETS))
+        self.occupancy = child(reg.histogram(
+            'paddle_tpu_serving_batch_occupancy',
+            'real rows per dispatched batch', L,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)))
+
+    def close(self):
+        """Retire this server's label series so a process cycling
+        servers (rolling reloads, test suites) doesn't grow the
+        registry and /metrics output without bound.  The server's own
+        handles stay usable for a final stats() read."""
+        for m in self._families:
+            m.remove(server=self._sid)
 
 
 def bucket_sizes(max_batch):
@@ -175,18 +246,24 @@ class BatchingInferenceServer(object):
         self._stage_to_device = jax.default_backend() != 'cpu'
 
         self._compiled = {}
-        self._lock = threading.Lock()
-        self._n_submitted = 0
-        self._n_completed = 0
-        self._n_batches = 0
-        self._rows_sum = 0
-        self._capacity_sum = 0
-        self._n_compiles = 0
-        self._n_compiles_after_warmup = 0
-        self._latencies = deque(maxlen=latency_window)
+        # stats live in the observability registry (the global one when
+        # metrics are enabled — labeled server="b<N>" and exported on
+        # /metrics — else a private registry so stats() keeps working);
+        # latency_window is retained for signature compatibility but the
+        # bounded-bucket histogram replaced the latency deque
+        del latency_window
+        sid = 'b%d' % next(_server_seq)
+        reg = _obs.registry() if _obs.enabled() \
+            else _obs.MetricsRegistry()
+        self._m = _ServingMetrics(reg, sid)
         self._warmup_done = False
         self._closed = False
         self._owned_dir = None  # set by from_program when it mkdtemp'd
+        # the serving runtime is the natural home of the opt-in scrape
+        # endpoint: first server construction starts it when
+        # PADDLE_TPU_METRICS_PORT is set (idempotent, daemon thread)
+        if _obs.enabled():
+            _obs.maybe_serve_from_env()
 
         if warmup:
             for b in self._buckets:
@@ -236,7 +313,8 @@ class BatchingInferenceServer(object):
                 raise RuntimeError("BatchingInferenceServer is closed")
             self._pending.append(req)
             self._pending_rows += rows
-            self._n_submitted += 1
+            self._m.submitted.inc()
+            self._m.queue_depth.set(len(self._pending))
             # wake the dispatcher only on the transitions it can act on:
             # first work after idle, or a bucket's worth accumulated.
             # In between it sleeps on its own linger/deadline timer —
@@ -251,36 +329,34 @@ class BatchingInferenceServer(object):
         return self.submit(feed).result(timeout)
 
     def stats(self):
+        """The same dict shape as before the observability rebase; the
+        values now read back from registry metrics (p50/p99 are
+        bucket-interpolated histogram quantiles rather than exact
+        order statistics over a sliding window)."""
         with self._cv:
             depth = len(self._pending)
             in_flight = self._in_flight
-        with self._lock:
-            lat = sorted(self._latencies)
-
-            def pct(p):
-                if not lat:
-                    return 0.0
-                return lat[min(int(p / 100.0 * len(lat)),
-                               len(lat) - 1)] * 1e3
-
-            batches = self._n_batches
-            return {
-                'queue_depth': depth,
-                'in_flight_batches': in_flight,
-                'requests_submitted': self._n_submitted,
-                'requests_completed': self._n_completed,
-                'batches': batches,
-                'mean_batch_occupancy':
-                    self._rows_sum / batches if batches else 0.0,
-                'mean_bucket_fill':
-                    self._rows_sum / self._capacity_sum
-                    if self._capacity_sum else 0.0,
-                'compiles': self._n_compiles,
-                'compiles_after_warmup': self._n_compiles_after_warmup,
-                'p50_latency_ms': pct(50),
-                'p99_latency_ms': pct(99),
-                'buckets': list(self._buckets),
-            }
+        m = self._m
+        batches = m.batches.value
+        rows_sum = m.batch_rows.value
+        capacity_sum = m.batch_capacity.value
+        return {
+            'queue_depth': depth,
+            'in_flight_batches': in_flight,
+            'requests_submitted': int(m.submitted.value),
+            'requests_completed': int(m.completed.value),
+            'batches': int(batches),
+            'mean_batch_occupancy':
+                rows_sum / batches if batches else 0.0,
+            'mean_bucket_fill':
+                rows_sum / capacity_sum if capacity_sum else 0.0,
+            'compiles': int(m.compiles.value),
+            'compiles_after_warmup':
+                int(m.compiles_after_warmup.value),
+            'p50_latency_ms': m.latency.quantile(0.5) * 1e3,
+            'p99_latency_ms': m.latency.quantile(0.99) * 1e3,
+            'buckets': list(self._buckets),
+        }
 
     def close(self, timeout=10.0):
         """Stop accepting requests, flush what is queued, and join the
@@ -294,6 +370,7 @@ class BatchingInferenceServer(object):
             self._cv_space.notify_all()
         self._dispatcher.join(timeout)
         self._collector.join(timeout)
+        self._m.close()  # retire this server's metric series
         if self._owned_dir:
             import shutil
             shutil.rmtree(self._owned_dir, ignore_errors=True)
@@ -398,12 +475,12 @@ class BatchingInferenceServer(object):
             zeros = {n: np.zeros((bucket,) + self._example_shapes[n],
                                  self._dtypes[n])
                      for n in self._feed_names}
-            fn = srv._call.lower(zeros, srv._key).compile()
+            with _obs.span('serving.bucket_compile'):
+                fn = srv._call.lower(zeros, srv._key).compile()
             self._compiled[bucket] = fn
-            with self._lock:
-                self._n_compiles += 1
-                if self._warmup_done:
-                    self._n_compiles_after_warmup += 1
+            self._m.compiles.inc()
+            if self._warmup_done:
+                self._m.compiles_after_warmup.inc()
         return fn
 
     # -- worker threads ------------------------------------------------
@@ -418,6 +495,7 @@ class BatchingInferenceServer(object):
             batch.append(self._pending.popleft())
             rows += r.rows
         self._pending_rows -= rows
+        self._m.queue_depth.set(len(self._pending))
         return batch
 
     def _flush_now(self, grew_full, t_first, now):
@@ -445,6 +523,7 @@ class BatchingInferenceServer(object):
                         if self._flush_now(grew_full, t_first, now):
                             batch = self._pop_batch()
                             self._in_flight += 1
+                            self._m.in_flight.set(self._in_flight)
                             self._cv_space.notify_all()  # queue space
                             break
                         if self._in_flight >= 2:
@@ -480,12 +559,14 @@ class BatchingInferenceServer(object):
                 r.future.set_exception(e)
             with self._cv:
                 self._in_flight -= 1
+                self._m.in_flight.set(self._in_flight)
                 self._cv.notify()
             return
-        with self._lock:
-            self._n_batches += 1
-            self._rows_sum += offsets[-1][1]
-            self._capacity_sum += bucket
+        rows = offsets[-1][1]
+        self._m.batches.inc()
+        self._m.batch_rows.inc(rows)
+        self._m.batch_capacity.inc(bucket)
+        self._m.occupancy.observe(rows)
         self._inflight_q.put((outs, reqs, offsets))
 
     def _collect_loop(self):
@@ -501,17 +582,23 @@ class BatchingInferenceServer(object):
                     r.future.set_exception(e)
                 with self._cv:
                     self._in_flight -= 1
+                    self._m.in_flight.set(self._in_flight)
                     self._cv.notify()
                 continue
             # the device is done: open the dispatch window BEFORE fanning
             # results out, so the next batch stages while clients wake
             with self._cv:
                 self._in_flight -= 1
+                self._m.in_flight.set(self._in_flight)
                 self._cv.notify()
             now = time.perf_counter()
-            with self._lock:
-                self._n_completed += len(reqs)
-                self._latencies.extend(
-                    now - r.t_submit for r in reqs)
+            self._m.completed.inc(len(reqs))
+            for r in reqs:
+                self._m.latency.observe(now - r.t_submit)
             for r, (lo, hi) in zip(reqs, offsets):
-                r.future.set_result([h[lo:hi] for h in host])
+                # copy partial slices: a view would pin the whole
+                # bucket-sized output (all co-batched rows + padding)
+                # for as long as any client holds its result
+                r.future.set_result(
+                    [h[lo:hi] if hi - lo == h.shape[0]
+                     else h[lo:hi].copy() for h in host])

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import export as jax_export
 
+from .. import observability as _obs
 from ..core import datatypes
 from ..core.executor import Executor, _maybe_enable_compilation_cache
 from ..core.place import default_place
@@ -76,8 +77,13 @@ def export_inference(path, feed_shapes, target_vars, executor=None,
         fetches, _ = fn(feed_vals, state_rw, state_ro, rng_key)
         return fetches
 
-    exported = jax_export.export(jax.jit(serve))(feed_arrays, rng_key)
-    blob = exported.serialize()
+    with _obs.span('serving.export'):
+        exported = jax_export.export(jax.jit(serve))(feed_arrays,
+                                                     rng_key)
+        blob = exported.serialize()
+    if _obs.enabled():
+        _obs.counter('paddle_tpu_serving_exports_total',
+                     'StableHLO inference artifacts exported').inc()
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
     with open(path, 'wb') as f:
         f.write(blob)
@@ -93,6 +99,9 @@ def _open_exported(path):
     _maybe_enable_compilation_cache()
     with open(path, 'rb') as f:
         exported = jax_export.deserialize(f.read())
+    if _obs.enabled():
+        _obs.counter('paddle_tpu_serving_artifacts_loaded_total',
+                     'StableHLO artifacts deserialized for serving').inc()
     return exported, jax.jit(exported.call)
 
 
@@ -142,7 +151,9 @@ class InferenceServer(object):
         self._run_chain = jax.jit(run_chain)
 
     def predict(self, feed):
-        return [np.asarray(o) for o in self.predict_async(feed)]
+        # span covers dispatch + the host sync, i.e. full call latency
+        with _obs.span('serving.predict'):
+            return [np.asarray(o) for o in self.predict_async(feed)]
 
     def predict_async(self, feed):
         """Dispatch one request without waiting; returns jax.Arrays.

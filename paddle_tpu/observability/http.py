@@ -1,0 +1,128 @@
+"""Opt-in stdlib-only HTTP endpoint: ``/metrics`` + ``/healthz``.
+
+A serving process (BatchingInferenceServer, or any trainer that wants
+scraping) calls ``serve_metrics(port)`` — or sets
+``PADDLE_TPU_METRICS_PORT`` and lets ``maybe_serve_from_env()`` start it
+— and a daemon thread answers:
+
+- ``GET /metrics``  -> Prometheus text exposition of the global registry
+- ``GET /healthz``  -> ``{"status": "ok", "uptime_s": ...}``
+
+stdlib ``http.server`` only: no web framework lands in the dependency
+set for a scrape endpoint that serves two GET routes.  The listener
+binds once per process (``maybe_serve_from_env`` is idempotent) and
+never blocks shutdown (daemon thread + SO_REUSEADDR).
+"""
+import json
+import threading
+import time
+
+from . import exporters as _exporters
+from .metrics import registry as _global_registry
+
+__all__ = ['MetricsHTTPServer', 'serve_metrics', 'maybe_serve_from_env']
+
+
+class MetricsHTTPServer(object):
+    """Handle for a running /metrics endpoint: ``.port`` is the bound
+    port (useful with port=0), ``.close()`` stops the listener."""
+
+    def __init__(self, port, host=None, reg=None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        if host is None:
+            from ..flags import FLAGS
+            host = FLAGS.metrics_host
+        reg = reg or _global_registry()
+        t_start = time.time()
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split('?', 1)[0]
+                if path == '/metrics':
+                    body = _exporters.prometheus_text(reg).encode()
+                    ctype = 'text/plain; version=0.0.4; charset=utf-8'
+                    code = 200
+                elif path in ('/healthz', '/health'):
+                    body = (json.dumps(
+                        {'status': 'ok',
+                         'uptime_s': round(time.time() - t_start, 3)})
+                        + '\n').encode()
+                    ctype = 'application/json'
+                    code = 200
+                else:
+                    body = b'paddle_tpu: /metrics and /healthz\n'
+                    ctype = 'text/plain'
+                    code = 404
+                self.send_response(code)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name='paddle-tpu-metrics-http', daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(5.0)
+
+
+def serve_metrics(port=None, host=None, reg=None):
+    """Start the /metrics + /healthz endpoint on a daemon thread.
+
+    :param port: TCP port; ``None`` reads ``PADDLE_TPU_METRICS_PORT``
+        (an unset/0 flag then raises — explicit calls must name a port
+        or set the env).  ``0`` binds an ephemeral port (tests).
+    :param host: bind address; ``None`` reads ``PADDLE_TPU_METRICS_HOST``
+        (default loopback — the listener is unauthenticated, so binding
+        wider must be a deliberate choice).
+    :returns: :class:`MetricsHTTPServer` (``.port``, ``.close()``).
+    """
+    if port is None:
+        from ..flags import FLAGS
+        port = FLAGS.metrics_port
+        if not port:
+            raise ValueError(
+                "serve_metrics(): no port given and "
+                "PADDLE_TPU_METRICS_PORT is unset/0")
+    return MetricsHTTPServer(port, host=host, reg=reg)
+
+
+_auto_server = None
+_auto_lock = threading.Lock()
+
+
+def maybe_serve_from_env():
+    """Start the endpoint iff ``PADDLE_TPU_METRICS_PORT`` is set to a
+    nonzero port; idempotent (one listener per process).  Called by the
+    serving runtime at startup; safe to call from anywhere.  Returns the
+    server handle or None."""
+    global _auto_server
+    with _auto_lock:
+        if _auto_server is not None:
+            return _auto_server
+        from ..flags import FLAGS
+        port = FLAGS.metrics_port
+        if not port:
+            return None
+        try:
+            _auto_server = MetricsHTTPServer(port)
+        except OSError as e:
+            # telemetry must never take serving down: a second process
+            # on the same host (EADDRINUSE) or a privileged port just
+            # means no endpoint here, not a dead server
+            import warnings
+            warnings.warn("metrics endpoint did not start on port %s: %s"
+                          % (port, e))
+            return None
+        return _auto_server

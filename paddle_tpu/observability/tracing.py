@@ -1,0 +1,92 @@
+"""Lightweight span tracing bridging the registry and XLA traces.
+
+``span("executor.compile")`` is a context manager that does two things
+at once:
+
+- feeds the wall-clock duration into the registry histogram
+  ``paddle_tpu_span_seconds{span="executor.compile"}`` (so /metrics
+  carries per-region latency distributions with no profiler attached);
+- annotates the XLA trace via ``jax.profiler.TraceAnnotation``, so when
+  a trace *is* being captured (profiler.py) the same region names show
+  up on the TensorBoard/Perfetto timeline.
+
+When metrics are disabled, ``span()`` returns one shared no-op object —
+no allocation, no annotation, no clock read — so instrumented paths cost
+a single function call.
+"""
+import time
+
+from . import metrics as _metrics
+
+__all__ = ['span']
+
+import threading
+
+_lock = threading.Lock()
+_span_children = {}  # span name -> histogram child handle
+
+
+class _NullSpan(object):
+    """Shared do-nothing span for the disabled path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _child(name):
+    child = _span_children.get(name)
+    if child is None:
+        hist = _metrics.registry().histogram(
+            'paddle_tpu_span_seconds',
+            'wall-clock duration of named host-side spans',
+            labelnames=('span',))
+        child = hist.labels(span=name)
+        with _lock:
+            _span_children.setdefault(name, child)
+    return child
+
+
+class _Span(object):
+    __slots__ = ('_child', '_ann', '_t0')
+
+    def __init__(self, child, ann):
+        self._child = child
+        self._ann = ann
+
+    def __enter__(self):
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.perf_counter() - self._t0)
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        return False
+
+
+def span(name, annotate=True):
+    """Context manager timing a host-side region into the registry.
+
+    :param name: dotted region name (``"executor.run"``); becomes the
+        ``span`` label on ``paddle_tpu_span_seconds``.
+    :param annotate: also open a ``jax.profiler.TraceAnnotation`` so the
+        region shows in captured XLA traces.  Pass False on regions hot
+        enough that the annotation's C++ hop matters.
+    :returns: the shared no-op span when metrics are disabled.
+    """
+    if not _metrics.enabled():
+        return _NULL_SPAN
+    ann = None
+    if annotate:
+        import jax
+        ann = jax.profiler.TraceAnnotation(name)
+    return _Span(_child(name), ann)

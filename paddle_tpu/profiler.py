@@ -7,6 +7,7 @@ XLA/TPU traces viewable in TensorBoard/Perfetto instead of nvprof output.
 import contextlib
 import os
 import time
+from collections import deque
 
 import jax
 
@@ -14,7 +15,17 @@ __all__ = ['profiler', 'cuda_profiler', 'CudaProfiler',
            'reset_profiler', 'RecordEvent',
            'start_profiler', 'stop_profiler', 'profile_table']
 
-_events = []
+
+def _event_cap():
+    """PADDLE_TPU_PROFILER_EVENT_CAP as a deque maxlen (None=unbounded):
+    long-lived serving processes wrap every request in RecordEvent, and
+    an unbounded list is a slow leak."""
+    from .flags import FLAGS
+    cap = int(FLAGS.profiler_event_cap)
+    return cap if cap > 0 else None
+
+
+_events = deque(maxlen=_event_cap())
 _last_log_dir = None
 
 
@@ -139,7 +150,10 @@ def profile_table(sorted_key='total', log_dir=None):
 
 
 def reset_profiler():
-    del _events[:]
+    """Drop recorded events; re-reads the event-cap flag so a process
+    can resize the bound at runtime (set the env, then reset)."""
+    global _events
+    _events = deque(maxlen=_event_cap())
 
 
 class RecordEvent(object):

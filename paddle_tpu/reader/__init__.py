@@ -8,13 +8,13 @@ behind `xmap_readers`/`buffered`; these decorators remain pure-python
 fallbacks with identical semantics.
 """
 from .decorator import (map_readers, buffered, compose, chain, shuffle,
-                        firstn, xmap_readers, cache, PipeReader,
+                        firstn, xmap_readers, cache, metered, PipeReader,
                         ComposeNotAligned)
 from .minibatch import batch
 from . import creator
 
 __all__ = [
     'map_readers', 'buffered', 'compose', 'chain', 'shuffle', 'firstn',
-    'xmap_readers', 'cache', 'PipeReader', 'ComposeNotAligned', 'batch',
-    'creator',
+    'xmap_readers', 'cache', 'metered', 'PipeReader', 'ComposeNotAligned',
+    'batch', 'creator',
 ]

@@ -11,11 +11,106 @@ import itertools
 import random
 import subprocess
 import threading
+import time
 import queue as _queue
 
+from .. import observability as _obs
+
 __all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
-           'firstn', 'xmap_readers', 'cache', 'PipeReader',
+           'firstn', 'xmap_readers', 'cache', 'metered', 'PipeReader',
            'ComposeNotAligned']
+
+
+class _ReaderMetrics(object):
+    """Registry handles for one reader pipeline stage, labeled by name
+    (``reader="buffered"``, or the user's ``metered`` name)."""
+
+    _cache = {}
+    _cache_lock = threading.Lock()
+
+    def __init__(self, name):
+        r = _obs.registry()
+        L = ('reader',)
+        sl = {'reader': name}
+        self.samples = r.counter(
+            'paddle_tpu_reader_samples_total',
+            'samples yielded by instrumented reader stages', L
+            ).labels(**sl)
+        self.rate = r.gauge(
+            'paddle_tpu_reader_samples_per_second',
+            'recent sample rate of instrumented reader stages '
+            '(updated every rate-window samples)', L).labels(**sl)
+        self.buffer_depth = r.gauge(
+            'paddle_tpu_reader_buffer_depth',
+            'samples sitting in the prefetch buffer', L).labels(**sl)
+
+    @classmethod
+    def get(cls, name):
+        with cls._cache_lock:
+            m = cls._cache.get(name)
+            if m is None:
+                m = cls._cache[name] = cls(name)
+            return m
+
+
+_RATE_WINDOW = 256  # samples between rate-gauge refreshes
+_DEPTH_WINDOW = 64  # samples between buffer-depth/count flushes
+
+
+class _SampleWindow(object):
+    """Amortized per-sample accounting shared by the instrumented reader
+    stages: ``hit()`` per delivered sample, locked metric updates only
+    once per ``window`` (counter inc, samples/sec gauge, and — when a
+    queue is given — its depth gauge).  ``flush()`` from a ``finally``
+    delivers the partial window so a consumer that stops early (firstn,
+    break, exception) never under-counts delivered samples."""
+    __slots__ = ('_m', '_window', '_n', '_t0')
+
+    def __init__(self, m, window):
+        self._m = m
+        self._window = window
+        self._n = 0
+        self._t0 = time.perf_counter()
+
+    def hit(self, q=None):
+        self._n += 1
+        if self._n >= self._window:
+            n, self._n = self._n, 0
+            self._m.samples.inc(n)
+            if q is not None:
+                self._m.buffer_depth.set(q.qsize())
+            t1 = time.perf_counter()
+            if t1 > self._t0:
+                self._m.rate.set(n / (t1 - self._t0))
+            self._t0 = t1
+
+    def flush(self):
+        if self._n:
+            self._m.samples.inc(self._n)
+            self._n = 0
+
+
+def metered(reader, name='reader'):
+    """Decorator: count samples (``paddle_tpu_reader_samples_total``)
+    and keep a recent samples/sec gauge for the wrapped creator.  A
+    no-op pass-through when metrics are disabled."""
+
+    def metered_reader():
+        it = reader()
+        if not _obs.enabled():
+            yield from it
+            return
+        w = _SampleWindow(_ReaderMetrics.get(name), _RATE_WINDOW)
+        try:
+            for sample in it:
+                # count before the yield: the yield IS the delivery, and
+                # a consumer that closes us right after still got it
+                w.hit()
+                yield sample
+        finally:
+            w.flush()
+
+    return metered_reader
 
 
 class ComposeNotAligned(ValueError):
@@ -118,10 +213,18 @@ def buffered(reader, size):
         t = threading.Thread(target=read_worker, args=(r, q))
         t.daemon = True
         t.start()
+        w = _SampleWindow(_ReaderMetrics.get('buffered'),
+                          _DEPTH_WINDOW) if _obs.enabled() else None
         e = q.get()
-        while not isinstance(e, EndSignal):
-            yield e
-            e = q.get()
+        try:
+            while not isinstance(e, EndSignal):
+                if w is not None:
+                    w.hit(q)
+                yield e
+                e = q.get()
+        finally:
+            if w is not None:
+                w.flush()
         if e.error is not None:
             raise e.error
 
@@ -260,15 +363,23 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             w.daemon = True
             w.start()
             workers.append(w)
+        w = _SampleWindow(_ReaderMetrics.get('xmap'),
+                          _DEPTH_WINDOW) if _obs.enabled() else None
         finish = 0
-        while finish < process_num:
-            sample = out_q.get()
-            if isinstance(sample, XmapEndSignal):
-                finish += 1
-            elif isinstance(sample, _XmapError):
-                raise sample.error
-            else:
-                yield sample
+        try:
+            while finish < process_num:
+                sample = out_q.get()
+                if isinstance(sample, XmapEndSignal):
+                    finish += 1
+                elif isinstance(sample, _XmapError):
+                    raise sample.error
+                else:
+                    if w is not None:
+                        w.hit(out_q)
+                    yield sample
+        finally:
+            if w is not None:
+                w.flush()
 
     return xreader
 

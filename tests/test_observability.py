@@ -1,0 +1,508 @@
+"""Observability subsystem: registry thread-safety, Prometheus
+exposition format, executor/serving instrumentation, the /metrics
+endpoint, the flags CLI, and the profiler event cap.
+
+The registry is process-wide and other tests feed it too, so every
+integration assertion here works on before/after deltas, never absolute
+values.
+"""
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import tracing
+
+# every non-comment exposition line must look like this (the scrape
+# contract from the issue): digit-free name, optional labels, a plain
+# numeric value
+SAMPLE_RE = re.compile(r'^[a-z_]+(\{[^}]*\})? [0-9.eE+-]+$')
+
+
+def _counter_value(snap, name, default=0.0):
+    fam = snap.get(name)
+    if not fam:
+        return default
+    return sum(s['value'] for s in fam['samples'])
+
+
+# -- registry primitives ---------------------------------------------------
+def test_counter_thread_safety_exact_total():
+    reg = obs.MetricsRegistry()
+    c = reg.counter('paddle_tpu_test_threads_total')
+    n_threads, per_thread = 8, 5000
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+
+
+def test_histogram_thread_safety_and_quantiles():
+    reg = obs.MetricsRegistry()
+    h = reg.histogram('paddle_tpu_test_latency_seconds')
+
+    def worker(vals):
+        for v in vals:
+            h.observe(v)
+
+    rng = np.random.RandomState(0)
+    all_vals = rng.uniform(1e-4, 0.5, size=(4, 2000))
+    threads = [threading.Thread(target=worker, args=(row,))
+               for row in all_vals]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == all_vals.size
+    np.testing.assert_allclose(h.sum, all_vals.sum(), rtol=1e-9)
+    # bucket-interpolated quantiles: monotone, inside the observed range
+    q50, q99 = h.quantile(0.5), h.quantile(0.99)
+    assert 0 < q50 <= q99 <= all_vals.max()
+
+
+def test_histogram_quantile_clamps_to_observed_max():
+    reg = obs.MetricsRegistry()
+    h = reg.histogram('paddle_tpu_test_overflow_seconds',
+                      buckets=(0.1, 1.0))
+    h.observe(50.0)  # lands in the +Inf bucket
+    assert h.quantile(0.99) == 50.0  # not inf
+
+
+def test_registry_get_or_create_and_kind_mismatch():
+    reg = obs.MetricsRegistry()
+    a = reg.counter('paddle_tpu_test_shared_total')
+    b = reg.counter('paddle_tpu_test_shared_total')
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge('paddle_tpu_test_shared_total')
+    with pytest.raises(ValueError):
+        reg.counter('paddle_tpu_test_shared_total',
+                    labelnames=('extra',))
+    with pytest.raises(ValueError):  # digits belong in label values
+        reg.counter('paddle_tpu_test_p99')
+
+
+def test_labels_create_independent_children():
+    reg = obs.MetricsRegistry()
+    g = reg.gauge('paddle_tpu_test_depth', labelnames=('server',))
+    g.labels(server='b0').set(3)
+    g.labels(server='b1').set(7)
+    assert g.labels(server='b0').value == 3
+    assert g.labels(server='b1').value == 7
+    with pytest.raises(ValueError):
+        g.labels(wrong='x')
+
+
+# -- exposition format -----------------------------------------------------
+def test_prometheus_exposition_golden_format():
+    reg = obs.MetricsRegistry()
+    c = reg.counter('paddle_tpu_test_requests_total', 'requests served',
+                    labelnames=('server',))
+    c.labels(server='b0').inc(3)
+    g = reg.gauge('paddle_tpu_test_queue_depth', 'queued requests')
+    g.set(2)
+    h = reg.histogram('paddle_tpu_test_seconds', 'latency',
+                      buckets=(0.001, 0.01, 0.1))
+    h.observe(0.005)
+    h.observe(0.5)
+    text = obs.prometheus_text(reg)
+    lines = text.splitlines()
+    for line in lines:
+        if line and not line.startswith('#'):
+            assert SAMPLE_RE.match(line), line
+    # golden lines (exact)
+    assert '# TYPE paddle_tpu_test_requests_total counter' in lines
+    assert 'paddle_tpu_test_requests_total{server="b0"} 3' in lines
+    assert '# HELP paddle_tpu_test_queue_depth queued requests' in lines
+    assert 'paddle_tpu_test_queue_depth 2' in lines
+    assert 'paddle_tpu_test_seconds_bucket{le="0.001"} 0' in lines
+    assert 'paddle_tpu_test_seconds_bucket{le="0.01"} 1' in lines
+    assert 'paddle_tpu_test_seconds_bucket{le="+Inf"} 2' in lines
+    assert 'paddle_tpu_test_seconds_count 2' in lines
+    # json snapshot round-trips
+    snap = json.loads(obs.json_snapshot(reg))
+    assert snap['paddle_tpu_test_seconds']['samples'][0]['count'] == 2
+
+
+def test_global_exposition_all_lines_parse():
+    """Whatever the instrumented layers have reported so far must render
+    scrapeable."""
+    for line in obs.prometheus_text().splitlines():
+        if line and not line.startswith('#'):
+            assert SAMPLE_RE.match(line), line
+
+
+# -- executor integration --------------------------------------------------
+def _tiny_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4])
+        y = fluid.layers.fc(input=x, size=2)
+    return main, startup, y
+
+
+def test_executor_plan_cache_counters_across_two_runs():
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {'x': np.ones((3, 4), np.float32)}
+    s0 = obs.snapshot()
+    exe.run(main, feed=feed, fetch_list=[y])  # miss (builds the plan)
+    exe.run(main, feed=feed, fetch_list=[y])  # hit
+    s1 = obs.snapshot()
+    d_miss = (_counter_value(s1, 'paddle_tpu_executor_plan_cache_misses_total')
+              - _counter_value(s0, 'paddle_tpu_executor_plan_cache_misses_total'))
+    d_hit = (_counter_value(s1, 'paddle_tpu_executor_plan_cache_hits_total')
+             - _counter_value(s0, 'paddle_tpu_executor_plan_cache_hits_total'))
+    d_runs = (_counter_value(s1, 'paddle_tpu_executor_runs_total')
+              - _counter_value(s0, 'paddle_tpu_executor_runs_total'))
+    d_compiles = (_counter_value(s1, 'paddle_tpu_executor_compiles_total')
+                  - _counter_value(s0, 'paddle_tpu_executor_compiles_total'))
+    d_feed = (_counter_value(s1, 'paddle_tpu_executor_feed_bytes_total')
+              - _counter_value(s0, 'paddle_tpu_executor_feed_bytes_total'))
+    assert d_miss == 1
+    assert d_hit == 1
+    assert d_runs == 2
+    assert d_compiles == 1  # only the first call paid the compile
+    assert d_feed == 2 * 3 * 4 * 4  # two runs of a (3,4) f32 feed
+    # run latency span recorded both calls
+    spans = s1.get('paddle_tpu_span_seconds')
+    assert spans is not None
+    run_spans = [s for s in spans['samples']
+                 if s['labels'].get('span') == 'executor.run']
+    assert run_spans and run_spans[0]['count'] >= 2
+
+
+def test_executor_close_clears_mesh_op_cache():
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={'x': np.ones((2, 4), np.float32)},
+            fetch_list=[y])
+    assert exe._mesh_op_cache  # run() populated it
+    exe.close()
+    assert exe._cache == {}
+    assert exe._mesh_op_cache == {}
+
+
+def test_compile_returns_bare_jit_fn_with_lower():
+    """compile()'s AOT consumers (memory_report, bench_ctr) call
+    fn.lower(*args).compile(); instrumentation must not wrap the jit
+    object away."""
+    main, startup, y = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fn, args = exe.compile(
+        main, feed={'x': np.ones((2, 4), np.float32)}, fetch_list=[y])
+    assert hasattr(fn, 'lower')
+    compiled = fn.lower(*args).compile()
+    assert compiled.cost_analysis() is not None
+
+
+def test_server_close_retires_metric_series():
+    """Closing a BatchingInferenceServer removes its server="bN" series
+    from the global registry (no unbounded growth across rolling server
+    reloads)."""
+    from paddle_tpu.inference import BatchingInferenceServer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4])
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    srv = BatchingInferenceServer.from_program(
+        {'x': (4,)}, [y], executor=exe, main_program=main, scope=scope,
+        max_batch=2, max_wait_ms=20.0, linger_ms=0.5)
+    sid = srv._m._sid
+    rng = np.random.RandomState(2)
+    srv.predict({'x': rng.randn(4).astype(np.float32)}, timeout=30.0)
+
+    def sids(snap):
+        out = set()
+        for name, fam in snap.items():
+            if name.startswith('paddle_tpu_serving_'):
+                for s in fam['samples']:
+                    out.add(s['labels'].get('server'))
+        return out
+
+    assert sid in sids(obs.snapshot())
+    srv.close()
+    assert sid not in sids(obs.snapshot())
+
+
+def test_disabled_mode_is_inert():
+    """With metrics off: spans collapse to the shared no-op and the
+    executor hot path reports nothing to the registry."""
+    obs.set_enabled(False)
+    try:
+        assert obs.span('anything') is tracing._NULL_SPAN
+        main, startup, y = _tiny_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        s0 = obs.snapshot()
+        feed = {'x': np.ones((3, 4), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[y])
+        exe.run(main, feed=feed, fetch_list=[y])
+        s1 = obs.snapshot()
+        for name in ('paddle_tpu_executor_plan_cache_hits_total',
+                     'paddle_tpu_executor_plan_cache_misses_total',
+                     'paddle_tpu_executor_runs_total',
+                     'paddle_tpu_executor_feed_bytes_total'):
+            assert _counter_value(s1, name) == _counter_value(s0, name)
+    finally:
+        obs.set_enabled(True)
+
+
+# -- serving integration (the acceptance scenario) -------------------------
+def test_train_loop_plus_serving_burst_populates_snapshot():
+    """ISSUE acceptance: after a 2-step train loop and a batched-serving
+    burst, snapshot() reports nonzero executor compile/cache-hit
+    counters and serving latency histograms."""
+    from paddle_tpu.inference import BatchingInferenceServer
+
+    s0 = obs.snapshot()
+    # 2-step train loop
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4])
+        y = fluid.layers.data(name='y', shape=[1])
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(8, 4).astype(np.float32),
+            'y': rng.randn(8, 1).astype(np.float32)}
+    for _ in range(2):
+        exe.run(main, feed=feed, fetch_list=[cost])
+
+    # batched-serving burst
+    imain, istartup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(imain, istartup):
+        xi = fluid.layers.data(name='x', shape=[4])
+        yi = fluid.layers.fc(input=xi, size=2)
+    scope = fluid.Scope()
+    exe.run(istartup, scope=scope)
+    srv = BatchingInferenceServer.from_program(
+        {'x': (4,)}, [yi], executor=exe, main_program=imain,
+        scope=scope, max_batch=4, max_wait_ms=20.0, linger_ms=0.5)
+    try:
+        futs = [srv.submit({'x': rng.randn(4).astype(np.float32)})
+                for _ in range(12)]
+        for f in futs:
+            f.result(timeout=30.0)
+        # snapshot while the server lives: close() retires its series
+        s1 = obs.snapshot()
+    finally:
+        srv.close()
+    assert (_counter_value(s1, 'paddle_tpu_executor_compiles_total')
+            > _counter_value(s0, 'paddle_tpu_executor_compiles_total'))
+    assert (_counter_value(s1, 'paddle_tpu_executor_plan_cache_hits_total')
+            > _counter_value(s0, 'paddle_tpu_executor_plan_cache_hits_total'))
+    lat = s1['paddle_tpu_serving_request_latency_seconds']
+    assert sum(s['count'] for s in lat['samples']) >= 12
+    assert all(s['labels'].get('server') for s in lat['samples'])
+    # and the whole thing still renders scrapeable
+    for line in obs.prometheus_text().splitlines():
+        if line and not line.startswith('#'):
+            assert SAMPLE_RE.match(line), line
+
+
+def test_batching_stats_backward_compat_shape():
+    """stats() keeps its pre-observability dict shape (keys and integer
+    counts) now that the values come from registry metrics."""
+    from paddle_tpu.inference import BatchingInferenceServer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4])
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    srv = BatchingInferenceServer.from_program(
+        {'x': (4,)}, [y], executor=exe, main_program=main, scope=scope,
+        max_batch=4, max_wait_ms=20.0, linger_ms=0.5)
+    try:
+        rng = np.random.RandomState(1)
+        for _ in range(5):
+            srv.predict({'x': rng.randn(4).astype(np.float32)},
+                        timeout=30.0)
+        st = srv.stats()
+        assert set(st) == {
+            'queue_depth', 'in_flight_batches', 'requests_submitted',
+            'requests_completed', 'batches', 'mean_batch_occupancy',
+            'mean_bucket_fill', 'compiles', 'compiles_after_warmup',
+            'p50_latency_ms', 'p99_latency_ms', 'buckets'}
+        for k in ('requests_submitted', 'requests_completed', 'batches',
+                  'compiles', 'compiles_after_warmup'):
+            assert isinstance(st[k], int), k
+        assert st['requests_completed'] == 5
+        assert st['compiles'] == 3  # buckets 1, 2, 4
+        assert 0 < st['p50_latency_ms'] <= st['p99_latency_ms']
+        assert st['buckets'] == [1, 2, 4]
+    finally:
+        srv.close()
+
+
+# -- /metrics endpoint -----------------------------------------------------
+def test_metrics_http_endpoint_serves_and_parses():
+    obs.counter('paddle_tpu_test_endpoint_total').inc()
+    h = obs.serve_metrics(port=0)  # ephemeral port
+    try:
+        base = 'http://127.0.0.1:%d' % h.port
+        body = urllib.request.urlopen(base + '/metrics',
+                                      timeout=10).read().decode()
+        assert 'paddle_tpu_test_endpoint_total 1' in body
+        for line in body.splitlines():
+            if line and not line.startswith('#'):
+                assert SAMPLE_RE.match(line), line
+        hz = json.loads(urllib.request.urlopen(
+            base + '/healthz', timeout=10).read().decode())
+        assert hz['status'] == 'ok'
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + '/nope', timeout=10)
+    finally:
+        h.close()
+
+
+def test_serve_metrics_without_port_or_flag_raises(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_METRICS_PORT', raising=False)
+    with pytest.raises(ValueError):
+        obs.serve_metrics()
+
+
+# -- reader metrics --------------------------------------------------------
+def test_metered_and_buffered_reader_count_samples():
+    from paddle_tpu import reader as reader_mod
+
+    def src():
+        for i in range(300):
+            yield i
+
+    s0 = obs.snapshot()
+    out = list(reader_mod.metered(src, name='unit')())
+    assert out == list(range(300))
+    out = list(reader_mod.buffered(src, 10)())
+    assert out == list(range(300))
+    s1 = obs.snapshot()
+    fam = s1['paddle_tpu_reader_samples_total']
+    by_label = {s['labels']['reader']: s['value'] for s in fam['samples']}
+    fam0 = s0.get('paddle_tpu_reader_samples_total', {'samples': []})
+    by_label0 = {s['labels']['reader']: s['value']
+                 for s in fam0['samples']}
+    assert by_label.get('unit', 0) - by_label0.get('unit', 0) == 300
+    assert by_label.get('buffered', 0) - by_label0.get('buffered', 0) \
+        == 300
+
+
+def test_metered_reader_flushes_on_early_abandon():
+    from paddle_tpu import reader as reader_mod
+
+    def src():
+        for i in range(1000):
+            yield i
+
+    s0 = obs.snapshot()
+    it = reader_mod.metered(src, name='abandon')()
+    for _, _ in zip(range(10), it):
+        pass
+    it.close()  # consumer walks away mid-window
+    s1 = obs.snapshot()
+    fam0 = {s['labels']['reader']: s['value'] for s in
+            s0.get('paddle_tpu_reader_samples_total',
+                   {'samples': []})['samples']}
+    fam1 = {s['labels']['reader']: s['value'] for s in
+            s1['paddle_tpu_reader_samples_total']['samples']}
+    assert fam1.get('abandon', 0) - fam0.get('abandon', 0) == 10
+
+
+def test_exposition_handles_non_finite_gauge():
+    reg = obs.MetricsRegistry()
+    g = reg.gauge('paddle_tpu_test_weird')
+    g.set(float('inf'))
+    text = obs.prometheus_text(reg)
+    assert 'paddle_tpu_test_weird +Inf' in text  # Prometheus spelling
+    g.set(float('nan'))
+    snap = json.loads(obs.json_snapshot(reg))  # strict JSON round-trip
+    assert snap['paddle_tpu_test_weird']['samples'][0]['value'] == 'NaN'
+
+
+def test_histogram_bucket_mismatch_is_an_error():
+    reg = obs.MetricsRegistry()
+    reg.histogram('paddle_tpu_test_b_seconds', buckets=(0.1, 1.0))
+    reg.histogram('paddle_tpu_test_b_seconds', buckets=(1.0, 0.1))  # same
+    with pytest.raises(ValueError):
+        reg.histogram('paddle_tpu_test_b_seconds', buckets=(0.5, 1.0))
+
+
+def test_maybe_serve_from_env_survives_port_conflict(monkeypatch):
+    from paddle_tpu.observability import http as obs_http
+
+    h = obs.serve_metrics(port=0)
+    try:
+        monkeypatch.setenv('PADDLE_TPU_METRICS_PORT', str(h.port))
+        monkeypatch.setattr(obs_http, '_auto_server', None)
+        with pytest.warns(UserWarning):
+            assert obs_http.maybe_serve_from_env() is None  # no crash
+    finally:
+        monkeypatch.setattr(obs_http, '_auto_server', None)
+        h.close()
+
+
+# -- profiler event cap (satellite regression) -----------------------------
+def test_profiler_events_bounded_by_flag(monkeypatch):
+    from paddle_tpu import profiler
+
+    monkeypatch.setenv('PADDLE_TPU_PROFILER_EVENT_CAP', '5')
+    profiler.reset_profiler()  # re-reads the cap
+    try:
+        for i in range(12):
+            with profiler.RecordEvent('ev%d' % i):
+                pass
+        events = profiler.get_events()
+        assert len(events) == 5  # bounded
+        assert [n for n, _ in events] == \
+            ['ev7', 'ev8', 'ev9', 'ev10', 'ev11']  # newest kept
+        profiler.reset_profiler()
+        assert profiler.get_events() == []
+    finally:
+        monkeypatch.delenv('PADDLE_TPU_PROFILER_EVENT_CAP',
+                           raising=False)
+        profiler.reset_profiler()  # restore the default cap
+
+
+# -- flags CLI (satellite) -------------------------------------------------
+def test_flags_cli_prints_help():
+    import os
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, '-m', 'paddle_tpu.flags'],
+        capture_output=True, text=True, timeout=300,
+        cwd=repo_root, env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert out.returncode == 0, out.stderr
+    for name in ('PADDLE_TPU_METRICS_ENABLED',
+                 'PADDLE_TPU_METRICS_PORT',
+                 'PADDLE_TPU_PROFILER_EVENT_CAP',
+                 'PADDLE_TPU_CHECK_NAN_INF'):
+        assert name in out.stdout, name
