@@ -78,7 +78,8 @@ def main():
               _build_fn('deepfm', sparse_dim, num_slots, 16),
               _feed_fn(batch, sparse_dim, num_slots), steps=steps,
               note='batch=%d slots=%d dim=%d (criteo-class)'
-                   % (batch, num_slots, sparse_dim))
+                   % (batch, num_slots, sparse_dim),
+              compile_stats=True)
 
     # table-height sweep: same batch/slots/embed, tables 1e5 -> 1e7;
     # touched rows per step constant (= batch x slots).  step_ms carries

@@ -36,7 +36,8 @@ def main():
     run_bench('stacked_lstm_tokens_per_sec', batch * seq, build, feed,
               steps=100 if on_tpu() else 3,
               note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab),
-              dtype='bfloat16')
+              dtype='bfloat16',
+              compile_stats=True)
 
 
 if __name__ == '__main__':

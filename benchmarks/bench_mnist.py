@@ -29,7 +29,8 @@ def main():
     # K=500 1.42M; b4096 regresses to 930k)
     run_bench('mnist_conv_examples_per_sec', batch, build, feed,
               steps=500 if on_tpu() else 5,
-              note='batch=%d' % batch)
+              note='batch=%d' % batch,
+              compile_stats=True)
 
 
 if __name__ == '__main__':

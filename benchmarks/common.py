@@ -32,9 +32,14 @@ def on_tpu():
 
 
 def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
-              note=None, dtype=None):
+              note=None, dtype=None, compile_stats=False):
     """build() -> (program, startup, loss_var); feed_fn() -> feed dict.
-    unit_count = units (imgs/tokens/examples) per step."""
+    unit_count = units (imgs/tokens/examples) per step.
+
+    With compile_stats=True the single-step plan is staged through jit's
+    AOT path first (fn.lower() -> .compile()) so the result carries
+    trace_s / compile_s columns plus the graph-opt pipeline report —
+    the numbers PADDLE_TPU_GRAPH_OPT_LEVEL exists to shrink."""
     import jax
     import paddle_tpu as fluid
 
@@ -50,6 +55,37 @@ def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
                     else jax.device_put(v, dev)) for k, v in f.items()}
 
     feed = stage(feed_fn())
+
+    cstats = {}
+    if compile_stats:
+        # cold-path cost of one plan build, measured stage by stage:
+        # graph-opt pass pipeline (inside compile()), trace to jaxpr
+        # (lower), XLA compile.  The jit call below re-compiles through
+        # its own cache, so steady-state numbers are unaffected.
+        t0 = time.perf_counter()
+        fn, args = exe.compile(program, feed=feed, fetch_list=[loss])
+        plan_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        lowered = fn.lower(*args)
+        trace_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        lowered.compile()
+        compile_s = time.perf_counter() - t0
+        cstats = {"plan_s": round(plan_s, 3),
+                  "trace_s": round(trace_s, 3),
+                  "compile_s": round(compile_s, 3)}
+        rep = exe.last_graph_opt_report
+        if rep:
+            cstats["graph_opt"] = {
+                "level": rep["level"],
+                "ops_before": rep["ops_before"],
+                "ops_after": rep["ops_after"],
+                "eliminated": rep["eliminated"],
+                "pass_wall_s": round(rep["pass_wall_s"], 4)}
+        else:
+            from paddle_tpu.flags import FLAGS
+            cstats["graph_opt"] = {"level": int(FLAGS.graph_opt_level),
+                                   "ops_before": None, "ops_after": None}
 
     # K steps as one compiled lax.scan (Executor.run_steps) sampled 3x,
     # median reported: per-step dispatch over the tunneled TPU costs a
@@ -72,6 +108,7 @@ def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
         "value": round(float(np.median(samples)), 2),
         "samples": [round(s, 1) for s in samples],
     }
+    result.update(cstats)
     if dtype:
         # structured workload marker: keeps the metric key stable across
         # the fp32 -> bf16 config change while making it machine-visible

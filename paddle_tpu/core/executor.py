@@ -106,6 +106,15 @@ class _ExecutorMetrics(object):
             'paddle_tpu_executor_donated_state_bytes_total',
             'bytes of persistable state donated into compiled '
             'steps').child()
+        self.graph_opt_ops_eliminated = r.counter(
+            'paddle_tpu_graph_opt_ops_eliminated_total',
+            'ops removed from traced programs by the graph-opt pass '
+            'pipeline (DCE + constant folding + CSE), summed over '
+            'plan builds').child()
+        self.graph_opt_seconds = r.histogram(
+            'paddle_tpu_graph_opt_seconds',
+            'wall time of one graph-opt pipeline run (per plan-cache '
+            'miss)', buckets=_obs.DEFAULT_COMPILE_BUCKETS).child()
 
 
 _exec_metrics = None
@@ -122,6 +131,19 @@ def _nbytes(arrays):
     """Total nbytes over a {name: array} dict (jax and numpy arrays both
     expose .nbytes; anything else counts 0)."""
     return sum(getattr(v, 'nbytes', 0) for v in arrays.values())
+
+
+def _graph_opt_level(program):
+    """Effective graph-opt level for a plan build: the
+    PADDLE_TPU_GRAPH_OPT_LEVEL flag (re-read on every build, so flips —
+    including after reset_cache() — take effect without a restart),
+    floored at 1 when memory_optimize()/release_memory() requested the
+    pipeline for this program."""
+    from ..transpiler.passes import _resolve_level
+    level = _resolve_level(None)
+    if getattr(program, '_graph_opt_requested', False):
+        level = max(level, 1)
+    return level
 
 
 class ExecutionContext(object):
@@ -204,7 +226,11 @@ def _run_one(op, env, ctx, op_index, frozen=()):
         ins[slot] = vals
     if impl.needs_env:
         ins['__env__'] = [env]
-    ctx.op_index = op_index
+    # per-op PRNG keys derive from the op's position; an op that survived
+    # the graph-opt pipeline carries its PRE-pass position as `op_seq`,
+    # so eliminating ops never shifts another op's RNG stream (dropout
+    # masks are bitwise-identical with and without optimization)
+    ctx.op_index = op.attrs.get('op_seq', op_index)
     outs = impl.compute(ctx, ins, op.attrs) or {}
     if '__env_update__' in outs:
         env.update(outs.pop('__env_update__')[0])
@@ -471,9 +497,15 @@ class Executor(object):
         self.place = place if place is not None else default_place()
         _maybe_enable_compilation_cache()
         self._cache = {}
+        self._plan_reports = {}  # plan key -> graph-opt report
         self._mesh_op_cache = {}
         self._step = 0
         self._plan_fresh = False  # set by _get_plan, read by run()
+        # graph-opt report of the most recently looked-up plan (tracked
+        # per plan key so cache hits restore the right one; None when
+        # that plan was built with the pipeline off) — see
+        # transpiler/passes.run_pipeline
+        self.last_graph_opt_report = None
 
     # ------------------------------------------------------------------
     def run(self,
@@ -647,11 +679,17 @@ class Executor(object):
         # embeds that mesh's shard_map in the compiled step.  Scope
         # identity is its monotonic _uid, never id(): ids recycle after
         # gc and would alias a fresh scope's plans with a dead one's.
+        # The graph-opt level participates too: a flag flip must not be
+        # served a plan traced at the old level.
+        opt_level = _graph_opt_level(program)
         key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names,
-               scope._uid, mesh)
+               scope._uid, mesh, opt_level)
         if use_cache and key in self._cache:
             self._plan_fresh = False
+            # keep the report describing THIS plan, not whichever plan
+            # happened to miss last (one executor can serve many programs)
+            self.last_graph_opt_report = self._plan_reports.get(key)
             if _obs.enabled():
                 _em().plan_cache_hits.inc()
             return self._cache[key]
@@ -676,6 +714,32 @@ class Executor(object):
                     "and is not fed" % n)
 
         prog = program
+        if opt_level > 0:
+            # rewrite a COPY of the block before tracing: dead-op
+            # elimination, constant folding, CSE (transpiler/passes.py).
+            # A pipeline failure must never take execution down with it
+            # — fall back to the unoptimized program.
+            from ..transpiler import passes
+            try:
+                prog, opt_report = passes.run_pipeline(
+                    program, fetch_names=fetch_names,
+                    feed_names=tuple(sorted(feed_arrays)),
+                    level=opt_level)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "graph-opt pipeline failed; tracing the unoptimized "
+                    "program", exc_info=True)
+                prog, opt_report = program, None
+            self.last_graph_opt_report = opt_report
+            if opt_report is not None and _obs.enabled():
+                em = _em()
+                em.graph_opt_ops_eliminated.inc(
+                    max(0, (opt_report['ops_before'] or 0) -
+                        (opt_report['ops_after'] or 0)))
+                em.graph_opt_seconds.observe(opt_report['pass_wall_s'])
+        else:
+            self.last_graph_opt_report = None
         backend = self.place.jax_device().platform
 
         def step_fn(feed_vals, state_rw, state_ro, rng_key):
@@ -698,6 +762,7 @@ class Executor(object):
         plan = (fn, step_fn, state_rw_names, state_ro_names)
         if use_cache:
             self._cache[key] = plan
+            self._plan_reports[key] = self.last_graph_opt_report
         return plan
 
     def run_steps(self, program=None, feed=None, fetch_list=None,
@@ -765,11 +830,14 @@ class Executor(object):
                                  fetch_names, True, mesh=mesh)
         _fn, raw_fn, rw_names, ro_names = fn_plan
 
+        # the graph-opt level keys the multi plan too: the scan closes
+        # over raw_fn, which traces the (un)optimized program — a flag
+        # flip must not be served a scan over the old one
         mkey = ('multi', program._uid, program.version, k, stacked,
                 fetch_names,
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
                       for n in sorted(feed0)), scope._uid,
-                rw_names, ro_names, mesh)
+                rw_names, ro_names, mesh, _graph_opt_level(program))
         multi = self._cache.get(mkey)
         multi_fresh = multi is None
         if multi_fresh:
@@ -870,8 +938,19 @@ class Executor(object):
                                               scope)
         return raw, args
 
+    def reset_cache(self):
+        """Drop every cached plan and re-read late-bound flags: the
+        persistent-compile-cache dir (PADDLE_TPU_COMPILATION_CACHE_DIR)
+        is re-applied, and the next plan build re-reads
+        PADDLE_TPU_GRAPH_OPT_LEVEL (the level is part of every plan key,
+        so flips invalidate naturally — this just frees the old plans)."""
+        self.close()
+        _maybe_enable_compilation_cache()
+
     def close(self):
         self._cache.clear()
+        self._plan_reports.clear()
+        self.last_graph_opt_report = None
         self._mesh_op_cache.clear()
         if hasattr(self, '_sharded_cache'):
             self._sharded_cache.clear()

@@ -48,6 +48,17 @@ def has_op(type):
     return type in _OP_REGISTRY
 
 
+def op_traits(type):
+    """(registered, stateful_rng, needs_env) for an op type WITHOUT
+    marking it as executed — the graph-opt pipeline classifies every op
+    in a block, and routing that through get_op_impl would make the
+    coverage meta-test (called_ops) see phantom executions."""
+    impl = _OP_REGISTRY.get(type)
+    if impl is None:
+        return (False, False, False)
+    return (True, impl.stateful_rng, impl.needs_env)
+
+
 def registered_ops():
     return sorted(_OP_REGISTRY)
 

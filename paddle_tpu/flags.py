@@ -93,6 +93,18 @@ DEFINE_int('profiler_event_cap', 10000,
            '(deque maxlen; oldest drop first) so long-lived serving '
            'processes using RecordEvent do not leak memory.  <=0 means '
            'unbounded; takes effect at import or on reset_profiler()')
+DEFINE_int('graph_opt_level', 2,
+           'graph-optimization pass pipeline applied to every program '
+           'block on a plan-cache miss, before tracing '
+           '(transpiler/passes.py): 0 disables, 1 runs dead-op '
+           'elimination only, 2 (default) adds constant folding and '
+           'common-subexpression elimination.  Re-read on every plan '
+           'build and part of the plan cache key, so flips (including '
+           'after Executor.reset_cache()) take effect without a '
+           'restart.  Levels 0 and 1 are fetch-exact; level 2 is '
+           'numerically equivalent (folded constants are evaluated '
+           'eagerly, so fused rounding in consumers can differ at ulp '
+           'scale)')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

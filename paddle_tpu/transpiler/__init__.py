@@ -1,3 +1,5 @@
 from .memory_optimize import memory_optimize, release_memory  # noqa: F401
+from . import passes  # noqa: F401
+from .passes import run_pipeline  # noqa: F401
 
-__all__ = ['memory_optimize', 'release_memory']
+__all__ = ['memory_optimize', 'release_memory', 'passes', 'run_pipeline']

@@ -29,29 +29,65 @@ _POLICIES = {
 }
 
 
+def _arm_pipeline(input_program, skip_opt_set):
+    """Shared memory_optimize/release_memory wiring into the graph-opt
+    pass pipeline (passes.py): request the pipeline for this program
+    (the executor floors the opt level at 1 — dead ops pin buffers),
+    record names the caller wants left alone, and attach the
+    donation/liveness report so callers can see what the analysis
+    found."""
+    from . import passes
+    if skip_opt_set:
+        skip = {s.name if hasattr(s, 'name') else str(s)
+                for s in skip_opt_set}
+        existing = getattr(input_program, '_graph_opt_skip_set', None)
+        input_program._graph_opt_skip_set = (existing or set()) | skip
+    input_program._graph_opt_requested = True
+    report = passes.analyze_donation(input_program)
+    input_program._donation_report = report
+    return report
+
+
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level='dots'):
-    """Mark `input_program` for rematerialization.  The executor wraps the
-    traced fwd+bwd closure in jax.checkpoint with the chosen policy on the
-    next (re)compile."""
+    """Mark `input_program` for rematerialization AND arm the graph-opt
+    pass pipeline (dead-op elimination + donation analysis) for it.  The
+    executor wraps the traced fwd+bwd closure in jax.checkpoint with the
+    chosen policy on the next (re)compile, and the pipeline drops dead
+    ops — whose outputs would otherwise sit live in the traced step —
+    on the next plan build."""
     if level is not None and level not in _POLICIES:
         raise ValueError("level must be one of %s or None"
                          % sorted(_POLICIES))
     input_program._remat_level = level
+    report = _arm_pipeline(input_program, skip_opt_set)
     input_program._bump_version()  # invalidate executor plan caches
     if print_log:
-        print("memory_optimize: remat level = %r" % level)
+        print("memory_optimize: remat level = %r; %d block "
+              "intermediates, %d donatable (%.1f KiB statically known), "
+              "%d die immediately"
+              % (level, report['intermediates'],
+                 len(report['donatable']),
+                 report['bytes_known'] / 1024.0,
+                 len(report['short_lived'])))
     return input_program
 
 
 def release_memory(input_program, skip_opt_set=None):
-    """Reference release_memory parity: buffer release is XLA's job (donated
-    inputs + liveness); nothing to rewrite — kept for API compatibility.
-    Logs that it intentionally did nothing so users don't mistake the
-    no-op for a memory optimization."""
-    _log.info("release_memory: no-op on TPU — XLA owns buffer lifetimes "
-              "(donated inputs + liveness analysis); use memory_optimize() "
-              "for rematerialization")
+    """Reference release_memory parity: the reference inserts early
+    delete ops; on TPU buffer release inside the step belongs to XLA
+    (donated inputs + liveness).  What this CAN do is arm the graph-opt
+    pipeline — dead ops are the one thing that provably pins buffers the
+    program doesn't need — and report the measured donation headroom."""
+    report = _arm_pipeline(input_program, skip_opt_set)
+    _log.info(
+        "release_memory: armed graph-opt pipeline (dead-op elimination on "
+        "next plan build); %d intermediates, %d donatable buffers "
+        "(%.1f KiB statically known) — in-step reuse is XLA's liveness "
+        "analysis, rematerialization is memory_optimize()",
+        report['intermediates'], len(report['donatable']),
+        report['bytes_known'] / 1024.0)
+    input_program._bump_version()
     return input_program
 
 

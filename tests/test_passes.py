@@ -1,0 +1,522 @@
+"""Graph-optimization pass pipeline tests (transpiler/passes.py).
+
+Golden small programs assert exact surviving op lists per pass;
+fetch-equivalence runs optimized vs. unoptimized programs (exact for
+level 1, allclose for level 2) on MNIST-sized and RNN-sized programs,
+including a while/sub-block program that must pass through untouched;
+plus the level-0 bypass, the memory_optimize/release_memory wiring, and
+the observability counters.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import passes
+
+
+def _op_types(program, block=0):
+    return [op.type for op in program.blocks[block].ops]
+
+
+def _run_program(main, startup, feed_fn, fetch_list, level, steps=3,
+                 monkeypatch=None):
+    """Run `steps` executor steps at a given opt level in a fresh scope;
+    returns (stacked fetches, last graph-opt report)."""
+    import os
+    old = os.environ.get('PADDLE_TPU_GRAPH_OPT_LEVEL')
+    os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = str(level)
+    try:
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            outs = []
+            for i in range(steps):
+                vals = exe.run(main, feed=feed_fn(i),
+                               fetch_list=fetch_list)
+                outs.append([np.asarray(v) for v in vals])
+            return outs, exe.last_graph_opt_report
+    finally:
+        if old is None:
+            os.environ.pop('PADDLE_TPU_GRAPH_OPT_LEVEL', None)
+        else:
+            os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = old
+
+
+# ---------------------------------------------------------------------------
+# golden per-pass programs
+# ---------------------------------------------------------------------------
+
+def test_dce_removes_dead_ops_exact_list():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        live = fluid.layers.scale(x, scale=2.0)
+        fluid.layers.scale(x, scale=9.0)      # dead
+        fluid.layers.elementwise_add(live, live)  # dead too
+    opt, rep = passes.run_pipeline(main, fetch_names=(live.name,),
+                                   feed_names=('x',), level=1)
+    assert _op_types(opt) == ['scale']
+    assert rep['eliminated'] == {'dce': 2}
+    assert rep['ops_before'] == 3 and rep['ops_after'] == 1
+    # the user's program is never mutated
+    assert len(main.global_block().ops) == 3
+
+
+def test_dce_keeps_persistable_writers():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        g = main.global_block().create_var(
+            name='counter', shape=[1], dtype='float32', persistable=True)
+        c = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                       value=1.0)
+        main.global_block().append_op(
+            type='assign', inputs={'X': [c]}, outputs={'Out': [g]})
+        y = fluid.layers.scale(x, scale=2.0)
+    opt, rep = passes.run_pipeline(main, fetch_names=(y.name,),
+                                   feed_names=('x',), level=1)
+    # nothing is fetched from the counter chain, but it writes a
+    # persistable: both its ops survive
+    assert _op_types(opt) == ['fill_constant', 'assign', 'scale']
+    assert rep['eliminated'] == {'dce': 0}
+
+
+def test_dce_keeps_effectful_ops():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.scale(x, scale=2.0)
+        # print's output is never consumed, but it has a host side effect
+        main.global_block().append_op(
+            type='print', inputs={'In': [y]},
+            outputs={'Out': ['print_out']}, attrs={'message': 'dbg '})
+    opt, _ = passes.run_pipeline(main, fetch_names=(y.name,),
+                                 feed_names=('x',), level=2)
+    assert 'print' in _op_types(opt)
+
+
+def test_constant_fold_collapses_chain():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        c = fluid.layers.fill_constant(shape=[2], dtype='float32',
+                                       value=2.0)
+        c2 = fluid.layers.scale(c, scale=3.0)
+        c3 = fluid.layers.elementwise_add(c2, c2)
+    opt, rep = passes.run_pipeline(main, fetch_names=(c3.name,), level=2)
+    # the whole chain becomes one assign_value holding [12, 12]
+    assert _op_types(opt) == ['assign_value']
+    (av,) = opt.global_block().ops
+    np.testing.assert_array_equal(
+        np.asarray(av.attrs['values'], dtype=np.float32),
+        np.array([12.0, 12.0], np.float32))
+
+
+def test_constant_fold_materializes_for_mixed_consumer():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        c = fluid.layers.fill_constant(shape=[2], dtype='float32',
+                                       value=2.0)
+        c2 = fluid.layers.scale(c, scale=3.0)
+        y = fluid.layers.elementwise_add(x, c2)
+    opt, _ = passes.run_pipeline(main, fetch_names=(y.name,),
+                                 feed_names=('x',), level=2)
+    # the const subtree folds to one assign_value; the data-dependent
+    # add survives and reads it
+    assert _op_types(opt) == ['assign_value', 'elementwise_add']
+
+
+def test_constant_fold_skips_persistable_and_feed_writers():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        p = main.global_block().create_var(
+            name='p', shape=[2], dtype='float32', persistable=True)
+        main.global_block().append_op(
+            type='fill_constant', outputs={'Out': [p]},
+            attrs={'shape': [2], 'dtype': 'float32', 'value': 1.0})
+    opt, rep = passes.run_pipeline(main, fetch_names=(), level=2)
+    assert _op_types(opt) == ['fill_constant']
+    assert rep['eliminated']['fold'] == 0
+
+
+def test_cse_dedupes_identical_subexpressions():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        a1 = fluid.layers.scale(x, scale=2.0)
+        a2 = fluid.layers.scale(x, scale=2.0)     # duplicate
+        a3 = fluid.layers.scale(x, scale=5.0)     # different attrs
+        y = fluid.layers.elementwise_add(a1, a2)
+        z = fluid.layers.elementwise_add(y, a3)
+    opt, rep = passes.run_pipeline(main, fetch_names=(z.name,),
+                                   feed_names=('x',), level=2)
+    assert rep['eliminated']['cse'] == 1
+    assert _op_types(opt) == ['scale', 'scale', 'elementwise_add',
+                              'elementwise_add']
+    # the surviving add reads the canonical name twice
+    add = opt.global_block().ops[2]
+    assert add.inputs['X'] == [a1.name]
+    assert add.inputs['Y'] == [a1.name]
+
+
+def test_cse_respects_name_redefinition():
+    """Two identical-looking ops are NOT duplicates when their shared
+    input name was redefined between them."""
+    main = fluid.Program()
+    b = main.global_block()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        a1 = fluid.layers.scale(x, scale=2.0)
+        # redefine x in place (non-SSA reassignment)
+        b.append_op(type='scale', inputs={'X': [x]},
+                    outputs={'Out': [x]}, attrs={'scale': 10.0})
+        a2 = fluid.layers.scale(x, scale=2.0)  # reads the NEW x
+        y = fluid.layers.elementwise_add(a1, a2)
+    opt, rep = passes.run_pipeline(main, fetch_names=(y.name,),
+                                   feed_names=('x',), level=2)
+    assert rep['eliminated']['cse'] == 0
+    assert len(_op_types(opt)) == 4
+    # and numerics agree with the unoptimized program
+    feed = {'x': np.arange(4, dtype=np.float32).reshape(1, 4)}
+    (r0,), _ = _run_program(main, fluid.Program(), lambda i: feed,
+                            [y.name], level=0, steps=1)
+    (r2,), _ = _run_program(main, fluid.Program(), lambda i: feed,
+                            [y.name], level=2, steps=1)
+    np.testing.assert_array_equal(r0[0], r2[0])
+
+
+def test_cse_skips_fetched_and_persistable_outputs():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        a1 = fluid.layers.scale(x, scale=2.0)
+        a2 = fluid.layers.scale(x, scale=2.0)
+        y = fluid.layers.elementwise_add(a1, a2)
+    # a2 is itself a fetch target -> its producer must survive
+    opt, rep = passes.run_pipeline(
+        main, fetch_names=(y.name, a2.name), feed_names=('x',), level=2)
+    assert rep['eliminated']['cse'] == 0
+    assert len(_op_types(opt)) == 3
+
+
+def test_rng_ops_never_folded_or_deduped():
+    main = fluid.Program()
+    b = main.global_block()
+    with fluid.program_guard(main):
+        u1 = b.create_var(name='u1', shape=[2, 2], dtype='float32')
+        u2 = b.create_var(name='u2', shape=[2, 2], dtype='float32')
+        for u in (u1, u2):  # two IDENTICAL rng ops: distinct draws
+            b.append_op(type='uniform_random', outputs={'Out': [u]},
+                        attrs={'shape': [2, 2], 'dtype': 'float32',
+                               'min': 0.0, 'max': 1.0})
+        y = fluid.layers.elementwise_add(u1, u2)
+    opt, rep = passes.run_pipeline(main, fetch_names=(y.name,), level=2)
+    assert _op_types(opt).count('uniform_random') == 2
+    assert rep['eliminated']['fold'] == 0
+    assert rep['eliminated']['cse'] == 0
+
+
+# ---------------------------------------------------------------------------
+# fetch equivalence
+# ---------------------------------------------------------------------------
+
+def _mnist_sized(dropout=False):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int32')
+        h = fluid.layers.fc(input=img, size=32, act='relu')
+        if dropout:
+            h = fluid.layers.dropout(h, dropout_prob=0.3)
+        # dead evaluation sidecar: fetch-pruned when only loss is fetched
+        dead = fluid.layers.fc(input=h, size=16, act='tanh')
+        fluid.layers.scale(dead, scale=3.0)
+        pred = fluid.layers.fc(input=h, size=10, act='softmax')
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg = fluid.layers.mean(x=cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+    return main, startup, avg
+
+
+def _mnist_feed(i):
+    rng = np.random.RandomState(100 + i)
+    return {'img': rng.randn(16, 784).astype('float32'),
+            'label': rng.randint(0, 10, (16, 1)).astype('int32')}
+
+
+@pytest.mark.parametrize('dropout', [False, True])
+def test_fetch_equivalence_mnist_sized(dropout):
+    main, startup, avg = _mnist_sized(dropout)
+    r0, rep0 = _run_program(main, startup, _mnist_feed, [avg.name], 0)
+    r1, rep1 = _run_program(main, startup, _mnist_feed, [avg.name], 1)
+    r2, rep2 = _run_program(main, startup, _mnist_feed, [avg.name], 2)
+    assert rep0 is None
+    # level 1 (DCE only) is EXACT — including the dropout RNG stream,
+    # which must not shift when the dead sidecar ops are removed
+    np.testing.assert_array_equal(np.ravel(r0), np.ravel(r1))
+    # level 2 adds folding/CSE: numerically equivalent
+    np.testing.assert_allclose(np.ravel(r0), np.ravel(r2),
+                               rtol=1e-5, atol=1e-6)
+    assert rep1['eliminated']['dce'] >= 2  # the sidecar fc + scale
+    assert rep2['ops_after'] < rep2['ops_before']
+
+
+def test_fetch_equivalence_rnn_sized():
+    from paddle_tpu.models import rnn_lm
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            src, target, avg_cost = rnn_lm.build(vocab_size=50)
+            fluid.optimizer.AdagradOptimizer(0.1).minimize(avg_cost)
+        return main, startup, avg_cost
+
+    def feed(i):
+        rng = np.random.RandomState(i)
+        ln = np.full((2,), 6, np.int32)
+        mk = lambda: rng.randint(1, 50, (2, 6, 1)).astype(np.int32)
+        return {'src': (mk(), ln), 'target': (mk(), ln)}
+
+    main, startup, avg = build()
+    r0, _ = _run_program(main, startup, feed, [avg.name], 0, steps=2)
+    r1, _ = _run_program(main, startup, feed, [avg.name], 1, steps=2)
+    r2, _ = _run_program(main, startup, feed, [avg.name], 2, steps=2)
+    np.testing.assert_array_equal(np.ravel(r0), np.ravel(r1))
+    np.testing.assert_allclose(np.ravel(r0), np.ravel(r2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_while_program_passes_through_untouched():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32')
+        i = fluid.layers.fill_constant(shape=[1], dtype='int32', value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype='int32',
+                                           value=4)
+        acc = fluid.layers.elementwise_add(x, x)  # data-dependent seed
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            nxt = fluid.layers.elementwise_add(acc, x)
+            fluid.layers.assign(nxt, acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+    before_g = _op_types(main)
+    before_s = _op_types(main, block=1)
+    opt, rep = passes.run_pipeline(main, fetch_names=(acc.name,),
+                                   feed_names=('x',), level=2)
+    # every global op feeds the loop (or is its barrier) and every
+    # sub-block op is out of the pipeline's reach: nothing changes
+    assert _op_types(opt) == before_g
+    assert _op_types(opt, block=1) == before_s
+
+    feed = {'x': np.array([[2.0]], np.float32)}
+    (r0,), _ = _run_program(main, startup, lambda i_: feed, [acc.name],
+                            0, steps=1)
+    (r2,), _ = _run_program(main, startup, lambda i_: feed, [acc.name],
+                            2, steps=1)
+    np.testing.assert_array_equal(r0[0], r2[0])
+    assert float(r0[0].ravel()[0]) == 12.0  # 2x + 4 iterations of +x
+
+
+def test_level0_bypass():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        fluid.layers.scale(x, scale=9.0)  # dead
+        y = fluid.layers.scale(x, scale=2.0)
+    opt, rep = passes.run_pipeline(main, fetch_names=(y.name,), level=0)
+    assert opt is main  # no copy, no rewrite
+    assert rep['level'] == 0 and rep['eliminated'] == {}
+
+    feed = {'x': np.ones((1, 2), np.float32)}
+    outs, report = _run_program(main, fluid.Program(),
+                                lambda i: feed, [y.name], 0, steps=1)
+    assert report is None  # executor skipped the pipeline entirely
+    np.testing.assert_array_equal(outs[0][0],
+                                  np.full((1, 2), 2.0, np.float32))
+
+
+def test_flag_flip_invalidates_plan_cache():
+    import os
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        fluid.layers.scale(x, scale=9.0)  # dead at fetch time
+        y = fluid.layers.scale(x, scale=2.0)
+    feed = {'x': np.ones((1, 2), np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    old = os.environ.get('PADDLE_TPU_GRAPH_OPT_LEVEL')
+    try:
+        os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = '2'
+        exe.run(main, feed=feed, fetch_list=[y.name])
+        assert exe.last_graph_opt_report['eliminated']['dce'] == 1
+        n_plans = len(exe._cache)
+        # flipping the flag must key a NEW plan, not reuse the level-2 one
+        os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = '0'
+        exe.run(main, feed=feed, fetch_list=[y.name])
+        assert len(exe._cache) > n_plans
+        assert exe.last_graph_opt_report is None
+        # reset_cache drops plans and stays functional
+        exe.reset_cache()
+        assert exe._cache == {}
+        exe.run(main, feed=feed, fetch_list=[y.name])
+    finally:
+        if old is None:
+            os.environ.pop('PADDLE_TPU_GRAPH_OPT_LEVEL', None)
+        else:
+            os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = old
+
+
+# ---------------------------------------------------------------------------
+# memory_optimize / release_memory wiring + donation analysis
+# ---------------------------------------------------------------------------
+
+def test_skip_opt_set_roots_dce():
+    """A producer whose only consumer is the skip set itself must
+    survive DCE (skip_opt_set means: leave these names alone)."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        aux = fluid.layers.scale(x, scale=3.0)  # not fetched
+        y = fluid.layers.scale(x, scale=2.0)
+    opt, rep = passes.run_pipeline(main, fetch_names=(y.name,),
+                                   feed_names=('x',), level=2,
+                                   extra_protected=(aux.name,))
+    assert _op_types(opt) == ['scale', 'scale']
+    assert rep['eliminated']['dce'] == 0
+    # and without the pin it IS dead
+    opt2, rep2 = passes.run_pipeline(main, fetch_names=(y.name,),
+                                     feed_names=('x',), level=2)
+    assert rep2['eliminated']['dce'] == 1
+
+
+def test_run_steps_respects_flag_flip():
+    """run_steps' multi-step scan closes over the traced step fn; a
+    graph-opt flag flip must key a fresh scan, not reuse the old one."""
+    import os
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        fluid.layers.scale(x, scale=9.0)  # dead
+        y = fluid.layers.scale(x, scale=2.0)
+    feed = {'x': np.ones((1, 2), np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    old = os.environ.get('PADDLE_TPU_GRAPH_OPT_LEVEL')
+    try:
+        os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = '2'
+        exe.run_steps(main, feed=feed, fetch_list=[y.name], repeat=2)
+        n_plans = len(exe._cache)
+        os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = '0'
+        out = exe.run_steps(main, feed=feed, fetch_list=[y.name],
+                            repeat=2)
+        assert len(exe._cache) > n_plans  # fresh single AND multi plans
+        np.testing.assert_array_equal(
+            np.asarray(out[0])[-1], np.full((1, 2), 2.0, np.float32))
+    finally:
+        if old is None:
+            os.environ.pop('PADDLE_TPU_GRAPH_OPT_LEVEL', None)
+        else:
+            os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = old
+
+
+def test_memory_optimize_wires_pipeline():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.scale(x, scale=2.0)
+        y = fluid.layers.scale(h, scale=3.0)
+    out = fluid.memory_optimize(main, skip_opt_set={h.name},
+                                print_log=False)
+    assert out is main  # back-compatible in-place signature
+    assert main._graph_opt_requested
+    assert h.name in main._graph_opt_skip_set
+    rep = main._donation_report
+    assert set(rep) == {'intermediates', 'donatable', 'short_lived',
+                        'bytes_known'}
+    assert h.name in rep['donatable']
+
+
+def test_release_memory_reports_instead_of_noop():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.scale(x, scale=2.0)
+        fluid.layers.scale(h, scale=3.0)
+    out = fluid.release_memory(main)
+    assert out is main
+    assert main._graph_opt_requested
+    assert main._donation_report['intermediates'] >= 1
+
+
+def test_memory_optimize_floors_level_at_dce():
+    """With the env flag at 0, a memory_optimize'd program still gets
+    DCE (the wiring: dead ops pin buffers)."""
+    import os
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        fluid.layers.scale(x, scale=9.0)  # dead
+        y = fluid.layers.scale(x, scale=2.0)
+    fluid.memory_optimize(main, level=None)  # no remat, just the wiring
+    old = os.environ.get('PADDLE_TPU_GRAPH_OPT_LEVEL')
+    try:
+        os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = '0'
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(main, feed={'x': np.ones((1, 2), np.float32)},
+                fetch_list=[y.name])
+        rep = exe.last_graph_opt_report
+        assert rep is not None and rep['level'] == 1
+        assert rep['eliminated']['dce'] == 1
+    finally:
+        if old is None:
+            os.environ.pop('PADDLE_TPU_GRAPH_OPT_LEVEL', None)
+        else:
+            os.environ['PADDLE_TPU_GRAPH_OPT_LEVEL'] = old
+
+
+def test_donation_analysis_lifetimes():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        a = fluid.layers.scale(x, scale=2.0)   # dies at the next op
+        b = fluid.layers.scale(a, scale=3.0)   # read twice below
+        c = fluid.layers.elementwise_add(b, b)
+        d = fluid.layers.elementwise_add(c, b)
+    rep = passes.analyze_donation(main, fetch_names=(d.name,),
+                                  feed_names=('x',))
+    assert a.name in rep['short_lived']
+    assert b.name in rep['donatable']
+    assert b.name not in rep['short_lived']
+    assert d.name not in rep['donatable']  # fetched -> escapes
+    assert rep['bytes_known'] > 0
+
+
+def test_pipeline_metrics_recorded():
+    pytest.importorskip('paddle_tpu.observability')
+    from paddle_tpu import observability as obs
+    if not obs.enabled():
+        pytest.skip('metrics disabled in this environment')
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        fluid.layers.scale(x, scale=9.0)  # dead
+        y = fluid.layers.scale(x, scale=2.0)
+    snap_name = 'paddle_tpu_graph_opt_ops_eliminated_total'
+
+    def counter_value():
+        fam = obs.snapshot().get(snap_name)
+        if not fam:
+            return 0.0
+        return sum(s.get('value', 0) for s in fam.get('samples', []))
+
+    before = counter_value()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(main, feed={'x': np.ones((1, 2), np.float32)},
+            fetch_list=[y.name])
+    assert counter_value() >= before + 1

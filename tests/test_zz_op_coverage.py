@@ -23,6 +23,57 @@ ALLOWED_UNCOVERED = set()
 FULL_SUITE_FLOOR = 300
 
 
+def test_graph_opt_classification_consistent_with_registry():
+    """The pass pipeline's purity whitelists must never contradict the
+    registry: an op registered with RNG or env access is not pure, and
+    every env op must be an explicit pipeline barrier.  This is the
+    structural guard against misclassifying a (new) op as pure."""
+    from paddle_tpu.transpiler import passes
+
+    for t in registry.registered_ops():
+        registered, stateful_rng, needs_env = registry.op_traits(t)
+        assert registered
+        if needs_env:
+            assert t in passes.EFFECTFUL_OPS, (
+                "env op %r must be in passes.EFFECTFUL_OPS" % t)
+        if stateful_rng or needs_env or t in passes.EFFECTFUL_OPS:
+            assert t not in passes.CSE_OPS, (
+                "op %r is rng/env/effectful but whitelisted for CSE" % t)
+            assert t not in passes.FOLDABLE_OPS, (
+                "op %r is rng/env/effectful but whitelisted for "
+                "folding" % t)
+    # folding implies CSE-grade purity, and whitelists only name real ops
+    assert passes.FOLDABLE_OPS <= passes.CSE_OPS
+    for t in passes.CSE_OPS | passes.EFFECTFUL_OPS:
+        assert registry.has_op(t), (
+            "whitelist entry %r is not a registered op" % t)
+
+
+def test_graph_opt_pipeline_survives_every_registered_op():
+    """Sweep: one synthetic single-op program per registered op type
+    through the full level-2 pipeline.  No pass may crash on any op,
+    and an op whose outputs are fetched must survive verbatim (nothing
+    is misclassified as foldable with unknown inputs)."""
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.transpiler import passes
+
+    for t in registry.registered_ops():
+        p = Program()
+        block = p.global_block()
+        block.append_op(
+            type=t,
+            inputs={'X': ['swp_in_a'], 'Y': ['swp_in_b']},
+            outputs={'Out': ['swp_out_%s' % t]},
+            attrs={})
+        opt, rep = passes.run_pipeline(
+            p, fetch_names=('swp_out_%s' % t,),
+            feed_names=('swp_in_a', 'swp_in_b'), level=2)
+        survivors = [op.type for op in opt.global_block().ops]
+        assert survivors == [t], (
+            "pipeline altered a fetched single-%r program: %s"
+            % (t, survivors))
+
+
 def test_every_registered_op_is_executed_by_the_suite(request):
     if len(request.session.items) < FULL_SUITE_FLOOR:
         pytest.skip("op-coverage meta-test needs the full suite "
