@@ -17,6 +17,14 @@ scale).  That is a TensorCore scatter-lowering property (the hardware
 answer to it is SparseCore), not a SelectedRows failure: a dense-grad
 design would pay the same table passes PLUS dense-grad materialization
 and traffic.
+
+Round 6 attacks the scatter term: the ops/pallas/table_update.py
+kernels walk only the touched rows (PADDLE_TPU_SPARSE_APPLY, default
+pallas on TPU) — the headline and sweep run under the resolved mode
+(labeled in their JSON), and `ctr_sparse_apply_micro` A/Bs the fused
+Adagrad apply XLA-vs-Pallas across table heights: the pallas column
+going height-flat where the xla column grows is the kernel doing its
+job.
 """
 import json
 import time
@@ -59,9 +67,78 @@ def _feed_fn(batch, sparse_dim, num_slots):
     return feed
 
 
+def _sparse_apply_micro(tpu):
+    """Scatter-apply micro: the fused sparse-Adagrad update (param +
+    moment) through BOTH lowerings, as a K-step donated-carry scan so
+    buffer aliasing matches the real train step.  Emits one JSON line
+    with the height sweep; `pallas_ms` staying flat from 1e5 to 1e7
+    rows while `xla_ms` grows is the acceptance shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.selected_rows import merge_duplicate_rows
+    from paddle_tpu.ops.pallas.table_update import sparse_apply_adagrad
+
+    heights = (100003, 1000003, 10000019) if tpu else (1009, 4001)
+    k = 131072 if tpu else 256
+    d = 8
+    steps = 50 if tpu else 2
+    lr = jnp.float32(0.01)
+    eps = 1e-6
+    rng = np.random.default_rng(5)
+
+    def xla_apply(p, mom, rows, vals):
+        # ops/optim_ops.py _adagrad sparse branch, verbatim
+        mrows, g, valid = merge_duplicate_rows(rows, vals)
+        vmask = valid[:, None]
+        mom_row = mom[mrows] + jnp.square(g)
+        mom_new = mom.at[mrows].add(jnp.where(vmask, jnp.square(g), 0.0))
+        step = -lr * g / (jnp.sqrt(mom_row) + eps)
+        return p.at[mrows].add(jnp.where(vmask, step, 0.0)), mom_new
+
+    def pallas_apply(p, mom, rows, vals):
+        return sparse_apply_adagrad(p, mom, rows, vals, lr, eps)
+
+    def chain(apply, rows, vals):
+        def fn(p, mom):
+            def body(c, _):
+                p, mom = c
+                return apply(p, mom, rows, vals), None
+            return jax.lax.scan(body, (p, mom), None, length=steps)[0]
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    sweep = []
+    for h in heights:
+        rows = jnp.asarray(rng.integers(0, h, size=(k,)).astype(np.int32))
+        vals = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+        row = {'table_rows': h}
+        for name, apply in (('xla', xla_apply), ('pallas', pallas_apply)):
+            fn = chain(apply, rows, vals)
+            p = jnp.asarray(rng.normal(size=(h, d)).astype(np.float32))
+            mom = jnp.abs(jnp.asarray(
+                rng.normal(size=(h, d)).astype(np.float32)))
+            p, mom = jax.block_until_ready(fn(p, mom))  # compile + warm
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                p, mom = jax.block_until_ready(fn(p, mom))
+                ts.append((time.perf_counter() - t0) / steps * 1e3)
+            row['%s_ms' % name] = round(float(np.median(ts)), 3)
+        sweep.append(row)
+    print(json.dumps({
+        'metric': 'ctr_sparse_apply_micro',
+        'value': sweep[-1]['pallas_ms'],
+        'sweep': sweep,
+        'note': 'fused sparse-Adagrad apply (param+moment), %d touched '
+                'rows x %d cols, %d-step donated scan; pallas flat '
+                'across heights = O(touched rows), xla grows = the '
+                'scatter table pass' % (k, d, steps)}))
+
+
 def main():
     from paddle_tpu.models.ctr import (CRITEO_NUM_SLOTS,
                                        CRITEO_SPARSE_DIM)
+    from paddle_tpu.ops.pallas.table_update import sparse_apply_mode
 
     tpu = on_tpu()
     if tpu:
@@ -77,9 +154,13 @@ def main():
     run_bench('ctr_deepfm_examples_per_sec', batch,
               _build_fn('deepfm', sparse_dim, num_slots, 16),
               _feed_fn(batch, sparse_dim, num_slots), steps=steps,
-              note='batch=%d slots=%d dim=%d (criteo-class)'
-                   % (batch, num_slots, sparse_dim),
+              note='batch=%d slots=%d dim=%d (criteo-class) '
+                   'sparse_apply=%s'
+                   % (batch, num_slots, sparse_dim, sparse_apply_mode()),
               compile_stats=True)
+
+    # scatter-apply micro: XLA vs Pallas across table heights
+    _sparse_apply_micro(tpu)
 
     # table-height sweep: same batch/slots/embed, tables 1e5 -> 1e7;
     # touched rows per step constant (= batch x slots).  step_ms carries
@@ -132,12 +213,15 @@ def main():
         'metric': 'ctr_table_height_sweep_step_ms',
         'value': rows[-1]['step_ms'],
         'sweep': rows,
-        'note': 'batch=%d slots=%d embed=8, %d touched rows/step; temp '
-                'bytes ~independent of table height (the ratio FALLS as '
-                'tables grow) = no dense [V,K] grad materializes; the '
-                'step_ms growth is the XLA:TPU scatter table pass '
-                '(PERF.md "CTR at Criteo scale")'
-                % (sweep_batch, sweep_slots, sweep_batch * sweep_slots)}))
+        'note': 'batch=%d slots=%d embed=8, %d touched rows/step, '
+                'sparse_apply=%s; temp bytes ~independent of table '
+                'height (the ratio FALLS as tables grow) = no dense '
+                '[V,K] grad materializes; under sparse_apply=xla the '
+                'step_ms growth is the XLA:TPU scatter table pass, '
+                'under pallas it should flatten (PERF.md "Pallas '
+                'row-sparse table update")'
+                % (sweep_batch, sweep_slots, sweep_batch * sweep_slots,
+                   sparse_apply_mode())}))
 
 
 if __name__ == '__main__':

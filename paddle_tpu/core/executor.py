@@ -133,6 +133,15 @@ def _nbytes(arrays):
     return sum(getattr(v, 'nbytes', 0) for v in arrays.values())
 
 
+def _sparse_apply_mode():
+    """Resolved sparse-apply lowering for a plan build (re-read every
+    build, like the graph-opt level, so PADDLE_TPU_SPARSE_APPLY flips
+    take effect on the next plan instead of silently serving a stale
+    trace)."""
+    from ..ops.pallas.table_update import sparse_apply_mode
+    return sparse_apply_mode()
+
+
 def _graph_opt_level(program):
     """Effective graph-opt level for a plan build: the
     PADDLE_TPU_GRAPH_OPT_LEVEL flag (re-read on every build, so flips —
@@ -680,11 +689,13 @@ class Executor(object):
         # identity is its monotonic _uid, never id(): ids recycle after
         # gc and would alias a fresh scope's plans with a dead one's.
         # The graph-opt level participates too: a flag flip must not be
-        # served a plan traced at the old level.
+        # served a plan traced at the old level.  Same for the sparse-
+        # apply lowering (PADDLE_TPU_SPARSE_APPLY): the pallas/xla
+        # choice is baked into the traced optimizer ops.
         opt_level = _graph_opt_level(program)
         key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names,
-               scope._uid, mesh, opt_level)
+               scope._uid, mesh, opt_level, _sparse_apply_mode())
         if use_cache and key in self._cache:
             self._plan_fresh = False
             # keep the report describing THIS plan, not whichever plan
@@ -837,7 +848,8 @@ class Executor(object):
                 fetch_names,
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
                       for n in sorted(feed0)), scope._uid,
-                rw_names, ro_names, mesh, _graph_opt_level(program))
+                rw_names, ro_names, mesh, _graph_opt_level(program),
+                _sparse_apply_mode())
         multi = self._cache.get(mkey)
         multi_fresh = multi is None
         if multi_fresh:

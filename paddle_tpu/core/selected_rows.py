@@ -15,7 +15,7 @@ the donated parameter buffer in place.
 import jax
 import jax.numpy as jnp
 
-__all__ = ['SelectedRows', 'merge_duplicate_rows']
+__all__ = ['SelectedRows', 'merge_duplicate_rows', 'merge_rows_sentinel']
 
 
 class SelectedRows(object):
@@ -64,4 +64,54 @@ def merge_duplicate_rows(rows, values):
     merged_rows = jnp.zeros((k,), jnp.int32).at[seg].set(srows)
     n_unique = seg[-1] + 1
     valid = jnp.arange(k) < n_unique
+    return merged_rows, merged_vals, valid
+
+
+def merge_rows_sentinel(rows, values, height, pad_to=None):
+    """merge_duplicate_rows with the SENTINEL slot convention the Pallas
+    table-update kernels (ops/pallas/table_update.py) consume: every
+    non-real output slot carries row index ``height`` — out of range, so
+    an XLA scatter consumer DROPS it (out-of-bounds updates are dropped)
+    and the kernel skips it; no `valid` masking of the values is needed
+    on either path.  Incoming ids outside [0, height) are treated as
+    padding and land in the sentinel tail too, which is what makes
+    RAGGED touched-row counts bucket-friendly: pad the id vector with
+    ``height`` up to a bucket size and the padding is exact no-ops.
+
+    ``pad_to`` right-pads the OUTPUT to a multiple of that many slots
+    (sentinel rows, zero values) — tile-aligned output, so a consumer
+    whose grid/blocking wants K % tile == 0 compiles one shape per
+    bucket instead of one per batch.
+
+    Returns (rows [K'], values [K', ...], valid [K'] bool)."""
+    rows = rows.astype(jnp.int32).reshape(-1)
+    k = rows.shape[0]
+    height = int(height)
+    if k == 0:
+        return rows, values, jnp.zeros((0,), bool)
+    in_range = (rows >= 0) & (rows < height)
+    rows_in = jnp.where(in_range, rows, height)
+    order = jnp.argsort(rows_in, stable=True)
+    srows = rows_in[order]
+    svals = values[order]
+    is_new = jnp.concatenate([jnp.ones((1,), bool),
+                              srows[1:] != srows[:-1]])
+    seg = jnp.cumsum(is_new) - 1
+    merged_vals = jax.ops.segment_sum(svals, seg, num_segments=k)
+    # unassigned tail segments keep the sentinel fill; the (single)
+    # sentinel segment, if any, writes `height` over it — same value
+    merged_rows = jnp.full((k,), height, jnp.int32).at[seg].set(srows)
+    n_valid = jnp.sum(is_new & (srows < height))
+    valid = jnp.arange(k) < n_valid
+    # sentinel slots may hold garbage segment sums (summed padding
+    # values); both consumers drop them by row id, so zeroing would be
+    # wasted work
+    if pad_to and k % int(pad_to):
+        pad = int(pad_to) - k % int(pad_to)
+        merged_rows = jnp.concatenate(
+            [merged_rows, jnp.full((pad,), height, jnp.int32)])
+        merged_vals = jnp.concatenate(
+            [merged_vals,
+             jnp.zeros((pad,) + merged_vals.shape[1:], merged_vals.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
     return merged_rows, merged_vals, valid

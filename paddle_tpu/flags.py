@@ -105,6 +105,16 @@ DEFINE_int('graph_opt_level', 2,
            'numerically equivalent (folded constants are evaluated '
            'eagerly, so fused rounding in consumers can differ at ulp '
            'scale)')
+DEFINE_string('sparse_apply', 'auto',
+              'lowering for the row-wise sparse optimizer apply '
+              '(SelectedRows grads in sgd/adagrad/adam): "pallas" runs '
+              'the O(touched-rows) Pallas table-update kernels '
+              '(ops/pallas/table_update.py, interpret mode off-TPU), '
+              '"xla" keeps the .at[rows].add scatter path (an '
+              'O(table-height) pass per scattered table on TPU), '
+              '"auto" (default) picks pallas on TPU and xla elsewhere. '
+              'Resolved per trace and part of the executor plan cache '
+              'key, so flips take effect on the next plan build')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

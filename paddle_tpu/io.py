@@ -352,11 +352,13 @@ def _write_manifest(dirname, manifest):
     # no window with zero manifests) — together with _gc_stale_generations
     # keeping its referenced data files and write_step_file archiving
     # STEP.prev, renaming the .prev files back restores the previous
-    # checkpoint.  Archived only when this write ADVANCES the
-    # newest generation: a checkpoint composed of several save_vars
-    # calls into one manifest (per-member saves) archives once, at the
-    # first write of the new generation, so .prev is always the last
-    # COMPLETE previous checkpoint, never a mid-checkpoint intermediate.
+    # checkpoint.  Archived only when this write CHANGES the newest
+    # generation (advance = new checkpoint, regress = rollback re-save;
+    # see _advances_generation): a checkpoint composed of several
+    # save_vars calls into one manifest (per-member saves) archives
+    # once, at the first write of the new generation, so .prev is always
+    # the last COMPLETE previous checkpoint, never a mid-checkpoint
+    # intermediate.
     # .prev does not match the __manifest__*.json read glob, so loads
     # never see it.
     if os.path.exists(path) and _advances_generation(path, manifest):
@@ -393,8 +395,17 @@ def _archive_prev(path):
 
 
 def _advances_generation(path, manifest):
-    """True when ``manifest`` carries a newer save generation than the
-    manifest file at ``path`` (unreadable/legacy files count as gen 0)."""
+    """True when ``manifest`` carries a DIFFERENT newest save generation
+    than the manifest file at ``path`` (unreadable/legacy files count as
+    gen 0).  Forward moves are new checkpoints; a BACKWARD move is a
+    rollback re-save claiming the directory, and it archives too — the
+    superseded higher-generation checkpoint becomes ``.prev``, keeping
+    the archived (params, step) pair consistent with write_step_file's
+    matching both-directions gate (a STEP.prev pointing at a step whose
+    params archive was never taken is exactly the downgrade desync
+    ADVICE.md flags).  Only an equal generation — a re-save of the same
+    checkpoint, e.g. per-member saves composing one generation — leaves
+    the archive alone."""
     def newest(m):
         return max([r.get('gen', 0) or 0
                     for r in m.get('vars', {}).values()] + [0])
@@ -403,7 +414,7 @@ def _advances_generation(path, manifest):
             on_disk = json.load(f)
     except (OSError, ValueError):
         return True
-    return newest(manifest) > newest(on_disk)
+    return newest(manifest) != newest(on_disk)
 
 
 def _read_manifest(dirname, own_only=False):
@@ -700,16 +711,19 @@ def write_step_file(dirname, step):
     data/LR-schedule position against older weights."""
     path = os.path.join(dirname, 'STEP')
     if os.path.exists(path):
-        # archive only when the step ADVANCES (mirrors the manifest's
-        # _advances_generation gate): re-saving the same step must not
-        # overwrite STEP.prev with the current step, or the archived
-        # (params, step) rollback pair desynchronizes
+        # archive when the step CHANGES, in either direction (mirrors
+        # the manifest's _advances_generation gate): re-saving the SAME
+        # step must not overwrite STEP.prev with the current step, but a
+        # rollback re-save of an EARLIER step must archive the
+        # superseded higher step right alongside the manifest archive —
+        # otherwise STEP.prev keeps a step whose params .prev no longer
+        # matches (the downgrade desync ADVICE.md flags)
         try:
             with open(path) as f:
                 on_disk = int(f.read().strip())
         except (OSError, ValueError):
             on_disk = None
-        if on_disk is None or int(step) > on_disk:
+        if on_disk is None or int(step) != on_disk:
             _archive_prev(path)
     # tmp+rename, NOT in-place: the archive may be a hardlink to the
     # current file's inode, and an in-place truncate-and-write would

@@ -10,12 +10,42 @@ Sparse grads arrive as a core/selected_rows.SelectedRows (or a raw
 into the donated buffers, the vocab-height dense grad never materializes
 (parity: sgd_op.cc / adagrad_op.cc sparse branches; adam applies lazily
 on the touched rows).  Other optimizers densify via scatter-add.
+
+The ROW-WISE apply itself has two interchangeable lowerings, selected
+per trace by ops/pallas/table_update.sparse_apply_mode():
+
+  'xla'    — the `.at[rows].add` scatter path below, verbatim.  Exact,
+             but XLA:TPU lowers every scatter as a full pass over the
+             table operand (O(table height) per scattered table —
+             PERF.md "CTR at Criteo scale").
+  'pallas' — ops/pallas/table_update.py: a grid over the touched rows
+             updates the donated table in place, O(touched rows), with
+             Adagrad's param+moment (and Adam's param+both-moments)
+             fused into ONE kernel pass.  Bitwise-identical to the XLA
+             path (tier-1 tests/test_pallas_table_update.py).
+
+PADDLE_TPU_SPARSE_APPLY=xla|pallas pins the path (default: pallas on
+TPU, xla elsewhere); the resolved mode is part of the executor's plan
+cache key, so a flip retraces.
 """
 import jax.numpy as jnp
 
 from ..core.registry import register_op
 from ..core.selected_rows import SelectedRows, merge_duplicate_rows
 from .common import first
+
+
+def _pallas_rowwise(p, values):
+    """True when the Pallas row-walking apply should serve this sparse
+    update: mode resolves to pallas and the operand is a rank-2 table
+    with matching row width (anything else falls back to the scatter
+    path — e.g. rank>2 params the kernels don't block for)."""
+    if getattr(p, 'ndim', 0) != 2 or getattr(values, 'ndim', 0) != 2:
+        return False
+    if p.shape[1] != values.shape[1]:
+        return False
+    from .pallas.table_update import sparse_apply_mode
+    return sparse_apply_mode() == 'pallas'
 
 
 def _p32(x):
@@ -54,6 +84,10 @@ def _sgd(ctx, ins, attrs):
     if sp is not None:
         # row-wise apply: duplicates accumulate (linear update)
         rows, values = sp
+        if _pallas_rowwise(p, values):
+            from .pallas.table_update import sparse_apply_sgd
+            p_new = sparse_apply_sgd(_p32(p), rows, _p32(values), lr)
+            return {'ParamOut': [p_new.astype(p.dtype)]}
         p_new = _p32(p).at[rows].add(-lr * _p32(values))
         return {'ParamOut': [p_new.astype(p.dtype)]}
     return {'ParamOut': [(_p32(p) - lr * _p32(grad)).astype(p.dtype)]}
@@ -92,6 +126,12 @@ def _adam(ctx, ins, attrs):
         # lazy sparse adam: moments decay and the param moves only on
         # touched rows; duplicate rows merge first (nonlinear update)
         rows, values = sp
+        if _pallas_rowwise(p, values):
+            from .pallas.table_update import sparse_apply_adam
+            p_new, m_new, v_new = sparse_apply_adam(
+                _p32(p), m, v, rows, _p32(values), lr_t, b1, b2, eps)
+            return {'ParamOut': [p_new.astype(p.dtype)],
+                    'Moment1Out': [m_new], 'Moment2Out': [v_new]}
         rows, g, valid = merge_duplicate_rows(rows, _p32(values))
         vmask = valid[:, None]
         m_row = b1 * m[rows] + (1 - b1) * g
@@ -140,6 +180,12 @@ def _adagrad(ctx, ins, attrs):
         # reference adagrad_op.cc sparse branch: merge duplicate rows,
         # then accumulate + step on the touched rows only
         rows, values = sp
+        if _pallas_rowwise(p, values):
+            from .pallas.table_update import sparse_apply_adagrad
+            p_new, mom_new = sparse_apply_adagrad(
+                _p32(p), mom, rows, _p32(values), lr, eps)
+            return {'ParamOut': [p_new.astype(p.dtype)],
+                    'MomentOut': [mom_new]}
         rows, g, valid = merge_duplicate_rows(rows, _p32(values))
         vmask = valid[:, None]
         mom_row = mom[rows] + jnp.square(g)

@@ -1,0 +1,14 @@
+"""Pallas API compatibility shims shared by the kernel modules.
+
+jax renamed ``TPUCompilerParams`` -> ``CompilerParams``; the TPU box and
+the CPU-CI container sit on opposite sides of the rename, so every
+kernel resolves it through this one alias."""
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, 'CompilerParams',
+                         getattr(pltpu, 'TPUCompilerParams', None))
+if CompilerParams is None:  # pragma: no cover - future-proofing
+    raise ImportError(
+        'jax.experimental.pallas.tpu exposes neither CompilerParams nor '
+        'TPUCompilerParams; update paddle_tpu/ops/pallas/_compat.py for '
+        'this jax version')

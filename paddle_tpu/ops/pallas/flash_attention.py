@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._compat import CompilerParams as _CompilerParams
+
 __all__ = ['flash_attention']
 
 _NEG_INF = -1e30
@@ -187,8 +189,8 @@ def _dimsem(*sems):
     The scoped-vmem limit is raised from the 16 MB default: the
     interior/masked two-branch tails hold two [bq, bk] fp32 tiles live
     (~18.4 MB at 1024x1024), and v5e has 128 MB of VMEM to spend."""
-    return pltpu.CompilerParams(dimension_semantics=sems,
-                                vmem_limit_bytes=64 * 1024 * 1024)
+    return _CompilerParams(dimension_semantics=sems,
+                           vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _fa_forward(q, k, v, causal, scale, block_q, block_k, interpret,
@@ -525,6 +527,41 @@ def _fa_bwd_fused_kernel(qoff_ref, koff_ref, q_ref, do_ref, lse_ref,
 _FUSED_DQ_BYTES = 16 * 1024 * 1024
 
 
+def _pow2_floor(n):
+    """Largest power of two <= n (n >= 1)."""
+    return 1 << (int(n).bit_length() - 1)
+
+
+def _clamp_blocks(b1, b2, t):
+    """Clamp the two split kernels' block sizes on one axis so the
+    SHARED padding (lcm of the two) stays bounded.  min(block, t) alone
+    can hand lcm a non-power-of-two: with the default d<=64 tiles,
+    tk=1100 clamps bk1 to 1100 and lcm(1100, 1024) = 281600 — a 256x
+    padding blowup in the k/v/dk/dv buffers and grid (ADVICE.md).  When
+    the naive clamp's lcm exceeds max(b1, b2), both blocks drop to the
+    largest power of two <= min(block, t); powers of two keep
+    lcm == max, so padding is bounded by one block.  Exactly-dividing
+    cases (t a multiple of both clamps) keep the naive clamp and its
+    zero padding."""
+    b1, b2 = min(b1, t), min(b2, t)
+    if math.lcm(b1, b2) > max(b1, b2):
+        b1, b2 = _pow2_floor(b1), _pow2_floor(b2)
+    return b1, b2
+
+
+def _shared_padding(tq, tk, tiles):
+    """Per-axis clamped block pairs + the shared padded lengths both
+    split backward kernels read from one padded buffer.  Split out of
+    _fa_backward_pallas so the padding arithmetic is unit-testable at
+    adversarial lengths."""
+    (bq1, bk1), (bq2, bk2) = tiles
+    bq1, bq2 = _clamp_blocks(bq1, bq2, tq)
+    bk1, bk2 = _clamp_blocks(bk1, bk2, tk)
+    tq_p = pl.cdiv(tq, math.lcm(bq1, bq2)) * math.lcm(bq1, bq2)
+    tk_p = pl.cdiv(tk, math.lcm(bk1, bk2)) * math.lcm(bk1, bk2)
+    return (bq1, bk1), (bq2, bk2), tq_p, tk_p
+
+
 def _fa_backward_pallas(causal, scale, tiles, res, do,
                         dlse, interpret, phases=('dkv', 'dq'),
                         allow_fused=True):
@@ -546,13 +583,9 @@ def _fa_backward_pallas(causal, scale, tiles, res, do,
     q, k, v, q_off, k_off, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
-    (bq1, bk1), (bq2, bk2) = tiles
-    bq1, bq2 = min(bq1, tq), min(bq2, tq)
-    bk1, bk2 = min(bk1, tk), min(bk2, tk)
     # one shared padding serves both kernels: pad to the lcm of the two
-    # block sizes on each axis (tiles are powers of two in practice)
-    tq_p = pl.cdiv(tq, math.lcm(bq1, bq2)) * math.lcm(bq1, bq2)
-    tk_p = pl.cdiv(tk, math.lcm(bk1, bk2)) * math.lcm(bk1, bk2)
+    # (clamped — see _clamp_blocks) block sizes on each axis
+    (bq1, bk1), (bq2, bk2), tq_p, tk_p = _shared_padding(tq, tk, tiles)
 
     dof = do.astype(jnp.float32)
     di = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # [BH, Tq]

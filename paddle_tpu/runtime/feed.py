@@ -66,6 +66,14 @@ class FeedPipeline(object):
             total += sizes[n]
         # at least two blocks per worker so every worker double-buffers
         depth = max(depth, 2 * self._workers)
+        if depth > 256:
+            # block tokens ride the native queue as single bytes; past
+            # 256 the encode below would die in an opaque ValueError
+            raise ValueError(
+                "FeedPipeline depth %d exceeds the 256-block arena "
+                "limit (depth is floored at 2*workers = %d; block "
+                "handoff tokens are single bytes) — lower depth or "
+                "workers" % (depth, 2 * self._workers))
         self._arena = StagingArena(block_size=max(total, 64),
                                    blocks=depth)
         self._blocks = [self._arena.acquire() for _ in range(depth)]
@@ -104,9 +112,16 @@ class FeedPipeline(object):
                 ok = self._fill(views, step)
             except BaseException as e:
                 # surface the pipeline failure to the consumer instead of
-                # masquerading as a clean end-of-stream
+                # masquerading as a clean end-of-stream.  Close EVERY
+                # ready ring, not just this worker's: the consumer may be
+                # blocked on (or first reach) another worker's ring — a
+                # clean end-of-stream there must not swallow this
+                # failure, and a ring whose worker never closes must not
+                # strand the consumer forever.  _error is set before the
+                # closes, so any None pop observes it.
                 self._error = e
-                self._ready[worker].close()
+                for q in self._ready:
+                    q.close()
                 return
             if ok is False:
                 self._free[worker].push(tok)  # unused block back

@@ -575,3 +575,57 @@ def test_step_prev_archives_only_on_advance(tmp_path):
         assert f.read().strip() == '1', "re-save clobbered STEP.prev"
     with open(os.path.join(d, 'STEP')) as f:
         assert f.read().strip() == '2'
+
+
+def test_downgrade_resave_archives_consistent_prev_pair(tmp_path):
+    """A rollback re-save (saving an EARLIER step over a newer on-disk
+    checkpoint) must archive BOTH the superseded STEP and manifest:
+    renaming the .prev pair back restores the (params, step) pair that
+    was superseded — never a stale higher step against mismatched
+    params (the downgrade desync ADVICE.md flags)."""
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    ckpt = str(tmp_path / 'downgrade')
+
+    _train_steps(exe, main, loss, 2)
+    io.save_checkpoint(exe, ckpt, main, step=9)
+    _train_steps(exe, main, loss, 2, seed=1)
+    io.save_checkpoint(exe, ckpt, main, step=10)
+    at_10 = {v.name: np.asarray(scope.find_var(v.name)).copy()
+             for v in main.list_vars()
+             if v.persistable and scope.find_var(v.name) is not None}
+    assert at_10
+
+    # the job rolls back its step counter and re-saves an earlier step
+    _train_steps(exe, main, loss, 2, seed=2)
+    io.save_checkpoint(exe, ckpt, main, step=3)
+    with open(os.path.join(ckpt, 'STEP')) as f:
+        assert int(f.read()) == 3
+    # the superseded pair is archived together...
+    with open(os.path.join(ckpt, 'STEP.prev')) as f:
+        assert int(f.read()) == 10, 'STEP.prev must hold the step it '\
+            'supersedes, not a pre-rollback leftover'
+    assert os.path.exists(os.path.join(ckpt, '__manifest__.json.prev'))
+
+    # ...and renaming the pair back round-trips to the step-10 state
+    os.replace(os.path.join(ckpt, '__manifest__.json.prev'),
+               os.path.join(ckpt, '__manifest__.json'))
+    os.replace(os.path.join(ckpt, 'STEP.prev'),
+               os.path.join(ckpt, 'STEP'))
+    for name, val in at_10.items():
+        scope.set(name, np.zeros_like(val))
+    step = io.load_checkpoint(exe, ckpt, main)
+    assert step == 10
+    for name, val in at_10.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)), val, err_msg=name)
+
+    # equal-step re-save still must NOT rotate the archive (the
+    # original gate's property survives the both-directions change):
+    # step 11 archives STEP.prev=10 once; re-saving 11 leaves it alone
+    io.save_checkpoint(exe, ckpt, main, step=11)
+    io.save_checkpoint(exe, ckpt, main, step=11)
+    with open(os.path.join(ckpt, 'STEP.prev')) as f:
+        assert int(f.read()) == 10

@@ -279,3 +279,60 @@ def test_rnn_vmem_budget_derives_from_device(monkeypatch):
     assert rnn._rnn_vmem_budget() == int(32 * 1024 * 1024 * 0.75)
     monkeypatch.setenv('PADDLE_TPU_RNN_VMEM_BUDGET_MB', '5')
     assert rnn._rnn_vmem_budget() == 5 * 1024 * 1024
+
+
+def test_shared_padding_clamps_adversarial_lengths():
+    """The shared backward padding must stay bounded by one block: the
+    lcm of the two split kernels' clamped block sizes explodes when a
+    sequence length lands between powers of two (tk=1100 under the
+    default d<=64 tiles used to pad to lcm(1100, 1024) = 281600 rows —
+    a 256x blowup, ADVICE.md).  Exactly-dividing lengths keep their
+    zero-padding behavior."""
+    from paddle_tpu.ops.pallas.flash_attention import _shared_padding
+    bwd_tiles = ((1024, 2048), (1024, 1024))  # default d<=64 dkv/dq
+    # the adversarial length from the advice item
+    (_, bk1), (_, bk2), _tq_p, tk_p = _shared_padding(8192, 1100,
+                                                      bwd_tiles)
+    assert (bk1, bk2) == (1024, 1024)
+    assert tk_p == 2048, tk_p  # not 281600
+    # another mixed-lcm case: 1280 used to pad to lcm(1280,1024) = 5120
+    _, _, _tq_p, tk_p = _shared_padding(8192, 1280, bwd_tiles)
+    assert tk_p == 2048, tk_p
+    # exactly-dividing lengths are untouched (no padding regression)
+    (_, bk1), (_, bk2), _tq_p, tk_p = _shared_padding(8192, 768,
+                                                      bwd_tiles)
+    assert (bk1, bk2) == (768, 768) and tk_p == 768
+    # q axis: equal clamped blocks never triggered the blowup
+    (bq1, _), (bq2, _), tq_p, _ = _shared_padding(160, 2048, bwd_tiles)
+    assert (bq1, bq2) == (160, 160) and tq_p == 160
+
+
+def test_pallas_backward_adversarial_tk_matches_scan(monkeypatch):
+    """End-to-end regression at the adversarial length: default
+    (mixed) backward tiles at tk=1100 run the clamped padding path and
+    the grads still match the scan recompute."""
+    b, t, h, d = 1, 1100, 1, 8
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    ct = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+
+    def loss(q, k, v):
+        # no explicit blocks: the per-phase default tiles are what
+        # produce the mixed (2048, 1024) k-axis pair under clamping
+        o = flash_attention(q, k, v, causal=True)
+        return jnp.sum(o * ct)
+
+    monkeypatch.setenv('PADDLE_TPU_FLASH_BWD_SCAN', '1')
+    jax.clear_caches()
+    g_scan = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_SCAN')
+    monkeypatch.setenv('PADDLE_TPU_FLASH_BWD_PALLAS', '1')
+    jax.clear_caches()
+    g_pal = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.delenv('PADDLE_TPU_FLASH_BWD_PALLAS')
+    jax.clear_caches()
+    for a, b_, name in zip(g_scan, g_pal, 'qkv'):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg='d' + name)

@@ -189,3 +189,62 @@ def test_xmap_single_worker_full_queue_error():
             list(r())
     finally:
         _native.available = orig
+
+
+def test_feed_pipeline_error_beats_stalled_sibling_ring():
+    """One worker's fill() exception must surface even when the
+    consumer is blocked on ANOTHER worker's ring (whose fill never
+    completes): the erroring worker closes every ready ring, so the
+    consumer wakes, sees the recorded error on the None pop, and
+    raises instead of hanging or reporting clean end-of-stream."""
+    import threading
+
+    import pytest
+
+    from paddle_tpu.runtime.feed import FeedPipeline
+
+    release = threading.Event()
+
+    def fill(views, step):
+        if step % 2 == 0:
+            # worker 0 (owns the ring the consumer waits on first):
+            # stall until teardown
+            release.wait(10)
+            return False
+        raise RuntimeError('worker 1 fill exploded')
+
+    pipe = FeedPipeline({'x': ((2,), np.float32)}, fill, workers=2,
+                        stage=False)
+    result = {}
+
+    def consume():
+        try:
+            for _ in pipe:
+                pass
+            result['end'] = 'clean'
+        except RuntimeError:
+            result['end'] = 'raised'
+
+    th = threading.Thread(target=consume, daemon=True)
+    th.start()
+    th.join(5)
+    release.set()
+    pipe.close()
+    assert result.get('end') == 'raised', result
+
+
+def test_feed_pipeline_depth_limit_clear_error():
+    """depth > 256 (directly or via the 2*workers floor) fails at
+    construction with an actionable message, not an opaque bytes()
+    ValueError from token encoding."""
+    import pytest
+
+    from paddle_tpu.runtime.feed import FeedPipeline
+
+    def fill(views, step):
+        return False
+
+    with pytest.raises(ValueError, match='256'):
+        FeedPipeline({'x': ((2,), np.float32)}, fill, depth=300)
+    with pytest.raises(ValueError, match='2\\*workers'):
+        FeedPipeline({'x': ((2,), np.float32)}, fill, workers=129)
