@@ -37,7 +37,19 @@ def main():
         steps = 2
 
     rng = np.random.default_rng(0)
-    dt = jnp.bfloat16 if tpu else jnp.float32
+    # f32-vs-bf16 side by side (PERF.md AMP table): bf16 is what the
+    # PADDLE_TPU_AMP=bf16 pass feeds this white-listed kernel, f32 is
+    # the full-precision baseline it replaces
+    for dt, amp_label in ((jnp.float32, 'off'), (jnp.bfloat16, 'bf16')):
+        _run_one(rng, flash_attention, B, T, H, D, steps, dt,
+                 amp_label, tpu)
+
+
+def _run_one(rng, flash_attention, B, T, H, D, steps, dt, amp_label,
+             tpu):
+    import jax
+    import jax.numpy as jnp
+
     q = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
     k = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
     v = jnp.asarray(rng.normal(size=(B, T, H, D)), dt)
@@ -84,7 +96,8 @@ def main():
         "metric": "flash_attention_causal_train_tokens_per_sec",
         "value": round(tokens_s, 2),
         "achieved_tflops": round(flops / dt_s / 1e12, 2),
-        "dtype": "bfloat16" if tpu else "float32",
+        "dtype": str(np.dtype(dt)) if dt != jnp.bfloat16 else "bfloat16",
+        "amp": amp_label,
         "note": "B=%d T=%d H=%d D=%d fwd+bwd%s" % (
             B, T, H, D, '' if tpu else ' cpu-smoke'),
     }))

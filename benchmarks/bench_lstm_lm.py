@@ -17,11 +17,11 @@ def main():
     else:
         batch, seq, vocab = 8, 16, 200
 
-    def build():
+    def build(dtype='bfloat16'):
         main_p, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_p, startup):
             src, target, avg_cost = rnn_lm.build(vocab_size=vocab,
-                                                 dtype='bfloat16')
+                                                 dtype=dtype)
             fluid.optimizer.AdagradOptimizer(0.1).minimize(avg_cost)
         return main_p, startup, avg_cost
 
@@ -38,6 +38,14 @@ def main():
               note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab),
               dtype='bfloat16',
               compile_stats=True)
+    # f32 build through the AMP pass: amp=off is the f32 baseline,
+    # amp=bf16 lowers the LSTM gates / fc / vocab head via the lists
+    run_bench('stacked_lstm_tokens_per_sec', batch * seq,
+              lambda: build(dtype='float32'), feed,
+              steps=100 if on_tpu() else 3,
+              note='batch=%d seq=%d vocab=%d f32-build' % (
+                  batch, seq, vocab),
+              amp_compare='bf16')
 
 
 if __name__ == '__main__':

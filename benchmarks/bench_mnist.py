@@ -26,11 +26,14 @@ def main():
 
     # K=500: the ~1.6 ms device step is dispatch-bound at short chains
     # over the tunneled chip (K=20 measured 315k ex/s, K=200 1.26M,
-    # K=500 1.42M; b4096 regresses to 930k)
+    # K=500 1.42M; b4096 regresses to 930k).
+    # amp_compare: two rows (amp=off / amp=bf16) — the f32-vs-bf16
+    # step-time and activation-bytes columns PERF.md tracks
     run_bench('mnist_conv_examples_per_sec', batch, build, feed,
               steps=500 if on_tpu() else 5,
               note='batch=%d' % batch,
-              compile_stats=True)
+              compile_stats=True,
+              amp_compare='bf16')
 
 
 if __name__ == '__main__':

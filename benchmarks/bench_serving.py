@@ -187,6 +187,7 @@ def main():
         print(json.dumps(r))
         results.append(r)
     results.extend(dynamic_scenario(tpu))
+    results.extend(amp_scenario(tpu))
     # attach the observability snapshot so BENCH_*.json runs carry the
     # queue/occupancy/latency telemetry behind the headline numbers
     # (empty when PADDLE_TPU_METRICS_ENABLED=0 — servers then report to
@@ -222,6 +223,56 @@ def _build_ctr_tower(n_sparse):
         h = fluid.layers.fc(input=h, size=128, act='relu')
         pred = fluid.layers.fc(input=h, size=1, act='sigmoid')
     return main_prog, startup, pred
+
+
+def amp_scenario(tpu):
+    """Inference-side AMP: the CTR tower exported bucketed at f32 vs
+    PADDLE_TPU_AMP=bf16 (export_bucketed amp='bf16' — the artifact
+    embeds the AMP-rewritten program: fc towers in bf16, weights cast
+    once at the graph edge), served at one bucket size side by side."""
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import export_bucketed
+    from paddle_tpu.inference import serving
+
+    n_sparse = 26
+    bucket = 8
+    n_chain = 30 if tpu else 5
+    main_prog, startup, pred = _build_ctr_tower(n_sparse)
+    place = fluid.TPUPlace(0) if tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    specs = {('C%d' % i): (1,) for i in range(n_sparse)}
+    specs['I'] = (13,)
+    rng = np.random.default_rng(0)
+    feed = {('C%d' % i):
+            rng.integers(0, 10000, size=(bucket, 1)).astype('int32')
+            for i in range(n_sparse)}
+    feed['I'] = rng.normal(size=(bucket, 13)).astype('float32')
+
+    results = []
+    for amp_label, amp_mode in (('off', '0'), ('bf16', 'bf16')):
+        paths = export_bucketed(
+            tempfile.mkdtemp(), specs, [pred], executor=exe,
+            main_program=main_prog, scope=scope, max_batch=bucket,
+            amp=amp_mode)
+        srv = serving.InferenceServer(paths[bucket])
+        np.asarray(srv.predict(feed)[0])  # compile + warm
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_chain):
+                np.asarray(srv.predict(feed)[0])
+            samples.append(bucket * n_chain /
+                           (time.perf_counter() - t0))
+        r = {"metric": "ctr_serving_bucketed_preds_per_sec",
+             "value": round(float(np.median(samples)), 2),
+             "samples": [round(s, 1) for s in samples],
+             "amp": amp_label,
+             "note": "b%d export_bucketed CTR tower" % bucket}
+        print(json.dumps(r))
+        results.append(r)
+    return results
 
 
 def dynamic_scenario(tpu):

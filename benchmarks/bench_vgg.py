@@ -14,15 +14,19 @@ def main():
     else:
         batch, hw, classes = 4, 32, 10
 
-    def build():
-        # bf16 activations, NHWC — the MXU recipe (same as bench.py)
+    def build(cast_bf16=True):
+        # bf16 activations, NHWC — the MXU recipe (same as bench.py);
+        # cast_bf16=False builds the pure-f32 program the AMP pass
+        # rewrites (the manual cast and the pass should converge)
         main_p, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_p, startup):
             img = fluid.layers.data(name='img', shape=[hw, hw, 3],
                                     dtype='float32')
             label = fluid.layers.data(name='label', shape=[1],
                                       dtype='int64')
-            x = fluid.layers.cast(x=img, dtype='bfloat16')
+            x = img
+            if cast_bf16:
+                x = fluid.layers.cast(x=img, dtype='bfloat16')
             pred = vgg.vgg_imagenet(x, num_classes=classes,
                                     layout='NHWC')
             cost = fluid.layers.mean(
@@ -42,6 +46,13 @@ def main():
               steps=40 if on_tpu() else 3,  # K=40: +8% vs K=10 (dispatch)
               note='batch=%d hw=%d NHWC' % (batch, hw),
               dtype='bfloat16')
+    # f32 build through the AMP pass: amp=off is the true f32 baseline,
+    # amp=bf16 should match the manual-cast headline above
+    run_bench('vgg16_train_img_per_sec', batch,
+              lambda: build(cast_bf16=False), feed,
+              steps=40 if on_tpu() else 3,
+              note='batch=%d hw=%d NHWC f32-build' % (batch, hw),
+              amp_compare='bf16')
 
 
 if __name__ == '__main__':

@@ -32,14 +32,43 @@ def on_tpu():
 
 
 def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
-              note=None, dtype=None, compile_stats=False):
+              note=None, dtype=None, compile_stats=False,
+              amp_compare=None):
     """build() -> (program, startup, loss_var); feed_fn() -> feed dict.
     unit_count = units (imgs/tokens/examples) per step.
 
     With compile_stats=True the single-step plan is staged through jit's
     AOT path first (fn.lower() -> .compile()) so the result carries
     trace_s / compile_s columns plus the graph-opt pipeline report —
-    the numbers PADDLE_TPU_GRAPH_OPT_LEVEL exists to shrink."""
+    the numbers PADDLE_TPU_GRAPH_OPT_LEVEL exists to shrink.
+
+    With amp_compare='bf16' (or 'f16') the whole measurement runs TWICE
+    — PADDLE_TPU_AMP off, then at that mode, each in a fresh scope —
+    and prints two JSON rows tagged with an ``amp`` column plus the
+    pass's ops_lowered/casts and the donation-analysis activation-bytes
+    estimate, so the f32-vs-bf16 step time and bytes read side by side.
+    Returns [row_off, row_amp]."""
+    if amp_compare:
+        import paddle_tpu as fluid
+        from paddle_tpu.transpiler.amp import amp_guard
+        results = []
+        for mode in ('0', amp_compare):
+            label = 'off' if mode == '0' else mode
+            scope = fluid.core.scope.Scope()
+            with amp_guard(mode), fluid.scope_guard(scope):
+                results.append(_bench_once(
+                    metric, unit_count, build, feed_fn, steps=steps,
+                    warmup=warmup, note=note, dtype=dtype,
+                    compile_stats=compile_stats, _amp_label=label))
+        return results
+    return _bench_once(metric, unit_count, build, feed_fn, steps=steps,
+                       warmup=warmup, note=note, dtype=dtype,
+                       compile_stats=compile_stats)
+
+
+def _bench_once(metric, unit_count, build, feed_fn, steps=20, warmup=3,
+                note=None, dtype=None, compile_stats=False,
+                _amp_label=None):
     import jax
     import paddle_tpu as fluid
 
@@ -109,6 +138,19 @@ def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
         "samples": [round(s, 1) for s in samples],
     }
     result.update(cstats)
+    if _amp_label is not None:
+        # f32-vs-bf16 rows: the mode, the pass's lowering stats, and the
+        # donation-analysis bytes of step intermediates (activations) —
+        # bf16 roughly halves it, the bandwidth half of the AMP win
+        result["amp"] = _amp_label
+        rep = exe.last_graph_opt_report or {}
+        arep = rep.get("amp")
+        if arep:
+            result["amp_ops_lowered"] = arep["ops_lowered"]
+            result["amp_casts"] = arep["casts_inserted"]
+        don = rep.get("donation")
+        if don:
+            result["act_bytes"] = don["bytes_known"]
     if dtype:
         # structured workload marker: keeps the metric key stable across
         # the fp32 -> bf16 config change while making it machine-visible

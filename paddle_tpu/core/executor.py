@@ -115,6 +115,14 @@ class _ExecutorMetrics(object):
             'paddle_tpu_graph_opt_seconds',
             'wall time of one graph-opt pipeline run (per plan-cache '
             'miss)', buckets=_obs.DEFAULT_COMPILE_BUCKETS).child()
+        self.amp_ops_lowered = r.counter(
+            'paddle_tpu_amp_ops_lowered_total',
+            'ops rewritten to low-precision compute by the AMP pass '
+            '(PADDLE_TPU_AMP), summed over plan builds').child()
+        self.amp_skipped_steps = r.counter(
+            'paddle_tpu_amp_skipped_steps_total',
+            'training steps skipped by dynamic loss scaling '
+            '(non-finite gradients; f16 mode only)').child()
 
 
 _exec_metrics = None
@@ -140,6 +148,15 @@ def _sparse_apply_mode():
     trace)."""
     from ..ops.pallas.table_update import sparse_apply_mode
     return sparse_apply_mode()
+
+
+def _amp_plan_key():
+    """Resolved AMP mode (+ loss-scale knobs) for a plan build — re-read
+    every build like the graph-opt level, and part of every plan cache
+    key so a PADDLE_TPU_AMP flip is never served a stale-precision
+    trace.  None when AMP is off."""
+    from ..transpiler.amp import plan_key_component
+    return plan_key_component()
 
 
 def _graph_opt_level(program):
@@ -221,6 +238,12 @@ def _cc_bwd(lo, hi, _res, g):
 _clip_cotangent.defvjp(_cc_fwd, _cc_bwd)
 
 
+# optimizers with a true row-wise SelectedRows rule (ops/optim_ops.py
+# sparse branches): a sentinel-gated grad row-set leaves their outputs
+# bitwise-unchanged, so AMP skip-step can gate on the ids alone
+_ROWWISE_SPARSE_OPS = frozenset({'sgd', 'adagrad', 'adam'})
+
+
 def _run_one(op, env, ctx, op_index, frozen=()):
     impl = get_op_impl(op.type)
     ins = {}
@@ -235,6 +258,44 @@ def _run_one(op, env, ctx, op_index, frozen=()):
         ins[slot] = vals
     if impl.needs_env:
         ins['__env__'] = [env]
+    # AMP f16 skip-step: an optimize-role op stamped with `amp_gate_var`
+    # (transpiler/amp.py) keeps every output's OLD value when the
+    # gradients of this step were non-finite — params, moments, and
+    # counters all stand still, the textbook loss-scaling skip.
+    # Dense updates gate on the outputs (jnp.where fuses into the
+    # elementwise update for free).  SelectedRows grads gate on the IDS
+    # instead: rows swap to the >=height sentinel on overflow (the PR-4
+    # ragged-padding contract — the Pallas kernel skips them, XLA drops
+    # the oob scatter), so no touched row exists and the donated
+    # in-place table update stays in place; a full-table output where
+    # would force XLA to keep the pre-update table live (copy + select,
+    # O(table height)) on EVERY step, reverting the row-sparse win.
+    gate = op.attrs.get('amp_gate_var')
+    gate_val = olds = None
+    if gate is not None and gate in env:
+        from .selected_rows import SelectedRows
+        gate_val = jnp.reshape(env[gate], ()).astype(bool)
+        sparse_gated = False
+        for slot, vals in list(ins.items()):
+            if slot == '__env__':
+                continue
+            gated_vals = []
+            for v in vals:
+                if isinstance(v, SelectedRows):
+                    v = SelectedRows(
+                        jnp.where(gate_val, v.height, v.rows),
+                        v.values, v.height)
+                    sparse_gated = True
+                gated_vals.append(v)
+            ins[slot] = gated_vals
+        if not (sparse_gated and op.type in _ROWWISE_SPARSE_OPS):
+            olds = {n: env[n] for n in op.output_arg_names if n in env}
+        # row-wise sparse ops need no output where: with every row at
+        # the sentinel, the kernel/scatter writes nothing and the
+        # outputs already equal the old state bitwise.  Optimizers that
+        # DENSIFY sparse grads (momentum & co) still decay their state
+        # on a zero grad, so they keep the output where — they pay the
+        # O(height) pass either way.
     # per-op PRNG keys derive from the op's position; an op that survived
     # the graph-opt pipeline carries its PRE-pass position as `op_seq`,
     # so eliminating ops never shifts another op's RNG stream (dropout
@@ -253,6 +314,8 @@ def _run_one(op, env, ctx, op_index, frozen=()):
                 # intermediate var): keep the injected leaf value so grads
                 # attach to it rather than to its producer.
                 continue
+            if olds is not None and n in olds:
+                v = jnp.where(gate_val, olds[n], v)
             try:
                 var = ctx.block.var_recursive(n)
                 if var.stop_gradient and not var.is_data:
@@ -337,6 +400,11 @@ def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
     grad_names = list(ad_op.attrs['grad_names'])
     loss_name = ad_op.attrs['loss_name']
     loss_scale = ad_op.attrs.get('loss_scale', 1.0)
+    # AMP dynamic loss scaling (transpiler/amp.py f16 mode): the scale
+    # is a persistable var, so it updates per step and rides the
+    # run_steps scan carry; check_finite_and_unscale divides it back out
+    # of the grads downstream.
+    ls_var = ad_op.attrs.get('loss_scale_var')
 
     captured = dict(env)
     # Keep the POST-update value only when every forward op in this slice
@@ -385,6 +453,9 @@ def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
             _run_one(op, env2, ctx, j, frozen)
         loss = env2[loss_name]
         loss = jnp.sum(loss.astype(jnp.float32)) * loss_scale
+        if ls_var is not None and ls_var in env2:
+            loss = loss * jnp.reshape(
+                jnp.asarray(env2[ls_var]).astype(jnp.float32), ())
         return loss, env2
 
     from ..transpiler.memory_optimize import get_remat_policy
@@ -588,7 +659,30 @@ class Executor(object):
                 scope.set(n, v)
             if return_numpy:
                 fetches = [np.asarray(v) for v in fetches]
+                if em is not None:
+                    self._note_amp_skips(new_state, scope)
         return fetches
+
+    def _note_amp_skips(self, new_state, scope):
+        """Surface the on-device cumulative AMP skip counter (f16
+        dynamic loss scaling) as a host-side metric.  Called only on
+        return_numpy paths — the step already synced, so the [1] scalar
+        read is a copy of a ready buffer, never a pipeline stall; async
+        (return_numpy=False) callers catch up on their next synced call
+        because the counter is cumulative.  The seen-watermark lives ON
+        the scope (the counter is scope state): it dies with the scope,
+        and two executors draining the same scope — e.g. one recreated
+        after a checkpoint reload — share it instead of each re-adding
+        the full historical count to the process-global metric."""
+        from ..transpiler.amp import SKIPPED_STEPS_VAR
+        v = new_state.get(SKIPPED_STEPS_VAR)
+        if v is None:
+            return
+        cur = int(np.asarray(v).reshape(-1)[0])
+        seen = getattr(scope, '_amp_skip_seen', 0)
+        if cur > seen:
+            _em().amp_skipped_steps.inc(cur - seen)
+        scope._amp_skip_seen = cur
 
     # ------------------------------------------------------------------
     def _mesh_and_dev(self, program):
@@ -692,10 +786,14 @@ class Executor(object):
         # served a plan traced at the old level.  Same for the sparse-
         # apply lowering (PADDLE_TPU_SPARSE_APPLY): the pallas/xla
         # choice is baked into the traced optimizer ops.
+        # ... and the AMP mode (PADDLE_TPU_AMP): a bf16-rewritten trace
+        # must never serve an f32 request or vice versa.
         opt_level = _graph_opt_level(program)
+        amp_key = _amp_plan_key()
         key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names,
-               scope._uid, mesh, opt_level, _sparse_apply_mode())
+               scope._uid, mesh, opt_level, _sparse_apply_mode(),
+               amp_key)
         if use_cache and key in self._cache:
             self._plan_fresh = False
             # keep the report describing THIS plan, not whichever plan
@@ -751,6 +849,50 @@ class Executor(object):
                 em.graph_opt_seconds.observe(opt_report['pass_wall_s'])
         else:
             self.last_graph_opt_report = None
+        if amp_key is not None:
+            # AMP cast-insertion pass (transpiler/amp.py), after the
+            # graph-opt pipeline so casts weave into the already-pruned
+            # block.  Same failure contract as the pipeline: a pass bug
+            # falls back to the unrewritten program with a warning.
+            from ..transpiler import amp as _amp
+            try:
+                # apply_amp deep-copies internally, so a weaver failure
+                # can never leave `prog` (the fallback) half-rewritten
+                amp_prog, amp_report = _amp.apply_amp(prog)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "AMP pass failed; tracing at full precision",
+                    exc_info=True)
+                amp_prog, amp_report = prog, None
+            if amp_report is not None:
+                prog = amp_prog
+                # seed the dynamic-loss-scale state (f16 mode) so the
+                # state analysis below sees live values — the user never
+                # runs a startup program for pass-created vars
+                for n, v in amp_report['state_defaults'].items():
+                    if not scope.has(n):
+                        scope.set(n, jnp.asarray(v))
+                rep = dict(self.last_graph_opt_report or
+                           {'level': opt_level, 'ops_before': None,
+                            'ops_after': None, 'eliminated': {},
+                            'pass_wall_s': 0.0})
+                rep['amp'] = amp_report
+                if 'donation' in rep:
+                    # re-derive over the rewritten block: lowered
+                    # intermediates are declared bf16/f16 now, so the
+                    # bytes estimate reflects the halved activations
+                    from ..transpiler.passes import analyze_donation
+                    rep['donation'] = analyze_donation(
+                        prog, fetch_names, tuple(sorted(feed_arrays)))
+                self.last_graph_opt_report = rep
+                # the rewrite can add persistable state: re-derive the
+                # rw/ro/out sets from the program that will actually
+                # trace (the pre-rewrite sets only keyed the cache)
+                state_rw_names, state_ro_names, state_out_names = \
+                    self._analyze_state(prog, scope, set(feed_arrays))
+                if _obs.enabled():
+                    _em().amp_ops_lowered.inc(amp_report['ops_lowered'])
         backend = self.place.jax_device().platform
 
         def step_fn(feed_vals, state_rw, state_ro, rng_key):
@@ -849,7 +991,7 @@ class Executor(object):
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
                       for n in sorted(feed0)), scope._uid,
                 rw_names, ro_names, mesh, _graph_opt_level(program),
-                _sparse_apply_mode())
+                _sparse_apply_mode(), _amp_plan_key())
         multi = self._cache.get(mkey)
         multi_fresh = multi is None
         if multi_fresh:
@@ -904,6 +1046,8 @@ class Executor(object):
                 scope.set(n, v)
             for n, v in last_extra.items():
                 scope.set(n, v)
+            if em is not None and return_numpy:
+                self._note_amp_skips(rw_f, scope)
             if return_numpy:
                 return [np.asarray(y) for y in ys]
             return list(ys)

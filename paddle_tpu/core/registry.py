@@ -9,9 +9,83 @@ XLA owns device lowering).  Signature:
 where `ins` is {slot: [arrays]} and ctx is an ExecutionContext giving access
 to PRNG keys and the interpreter (for ops with sub-blocks).
 """
+import collections
 
 _OP_REGISTRY = {}
 _CALLED = set()  # op types fetched for execution (coverage meta-test)
+
+# ---------------------------------------------------------------------------
+# AMP (automatic mixed precision) op classification — consumed by the
+# transpiler/amp.py cast-insertion pass and reported through op_traits().
+#
+# AMP_WHITE: matmul-shaped ops whose FLOPs land on the MXU — these run in
+# the low precision (bf16/f16) under PADDLE_TPU_AMP; the win is ~2x matmul
+# throughput plus halved activation bandwidth.
+#
+# AMP_BLACK: ops that must stay f32 — losses and softmaxes (dynamic
+# range), normalization statistics, wide accumulations (sum/mean),
+# range-sensitive elementwise math (exp/log/pow/square), metrics, the
+# optimizer updates (f32 master weights), and the AMP machinery itself.
+#
+# Everything else is GREY: precision follows the inputs (an elementwise op
+# between two bf16 values runs in bf16; one fed a f32 value stays f32).
+# A newly registered op is grey by default, which is always SAFE — it can
+# never force a value into low precision on its own — and
+# tests/test_zz_op_coverage.py asserts every registered op lands in
+# exactly one class so list rot is caught structurally.
+AMP_WHITE = frozenset({
+    'matmul', 'mul',
+    'conv2d', 'conv2d_transpose', 'conv3d', 'conv3d_transpose',
+    'sequence_conv', 'conv_shift', 'row_conv',
+    'bilinear_tensor_product', 'flash_attention',
+    'lstm', 'lstm_unit', 'gru', 'gru_unit',
+    # fused vocab-head CE ops: dominated by the [N,D]x[D,V] matmul and
+    # internally f32-safe (preferred_element_type accumulation + f32
+    # softmax state), so their INPUTS lower; their loss outputs are
+    # always f32 (amp.py WHITE_F32_OUTPUT_OPS)
+    'fused_linear_softmax_ce', 'vocab_parallel_ce',
+})
+
+AMP_BLACK = frozenset({
+    # softmax family + losses (dynamic range / reductions over logits)
+    'softmax', 'sequence_softmax',
+    'cross_entropy', 'softmax_with_cross_entropy',
+    'sigmoid_cross_entropy_with_logits', 'square_error_cost',
+    'smooth_l1', 'smooth_l1_loss', 'hinge_loss', 'huber_loss',
+    'log_loss', 'margin_rank_loss', 'modified_huber_loss', 'rank_loss',
+    'warpctc', 'nce', 'linear_chain_crf', 'crf_decoding',
+    # normalization / statistics
+    'batch_norm', 'layer_norm', 'norm', 'lrn', 'l1_norm',
+    'squared_l2_norm', 'squared_l2_distance', 'cos_sim', 'clip_by_norm',
+    # wide accumulations
+    'sum', 'mean', 'reduce_sum', 'reduce_mean', 'reduce_prod',
+    # range-sensitive elementwise math
+    'exp', 'log', 'pow', 'square',
+    # metrics
+    'accuracy', 'auc', 'precision_recall', 'positive_negative_pair',
+    'chunk_eval', 'edit_distance', 'detection_output',
+    # optimizer updates apply to the f32 masters
+    'sgd', 'momentum', 'adam', 'adamax', 'adagrad', 'decayed_adagrad',
+    'adadelta', 'rmsprop', 'ftrl', 'proximal_gd', 'proximal_adagrad',
+    # grad machinery + the AMP ops themselves
+    'sparse_grad_assemble', 'check_finite_and_unscale',
+    'update_loss_scale',
+})
+
+
+def amp_class(type):
+    """'white' | 'black' | 'grey' AMP classification for an op type.
+    Unregistered/unknown types are grey (the safe default: grey can
+    never lower a value's precision on its own)."""
+    if type in AMP_WHITE:
+        return 'white'
+    if type in AMP_BLACK:
+        return 'black'
+    return 'grey'
+
+
+OpTraits = collections.namedtuple(
+    'OpTraits', ['registered', 'stateful_rng', 'needs_env', 'amp'])
 
 
 class OpImpl(object):
@@ -49,14 +123,17 @@ def has_op(type):
 
 
 def op_traits(type):
-    """(registered, stateful_rng, needs_env) for an op type WITHOUT
-    marking it as executed — the graph-opt pipeline classifies every op
-    in a block, and routing that through get_op_impl would make the
-    coverage meta-test (called_ops) see phantom executions."""
+    """OpTraits(registered, stateful_rng, needs_env, amp) for an op type
+    WITHOUT marking it as executed — the graph-opt and AMP pipelines
+    classify every op in a block, and routing that through get_op_impl
+    would make the coverage meta-test (called_ops) see phantom
+    executions.  `amp` is 'white' | 'black' | 'grey' (see AMP_WHITE /
+    AMP_BLACK above; grey = follow-the-inputs default)."""
     impl = _OP_REGISTRY.get(type)
     if impl is None:
-        return (False, False, False)
-    return (True, impl.stateful_rng, impl.needs_env)
+        return OpTraits(False, False, False, amp_class(type))
+    return OpTraits(True, impl.stateful_rng, impl.needs_env,
+                    amp_class(type))
 
 
 def registered_ops():

@@ -115,6 +115,27 @@ DEFINE_string('sparse_apply', 'auto',
               '"auto" (default) picks pallas on TPU and xla elsewhere. '
               'Resolved per trace and part of the executor plan cache '
               'key, so flips take effect on the next plan build')
+DEFINE_string('amp', '0',
+              'automatic mixed-precision training pass '
+              '(transpiler/amp.py), applied per plan build after the '
+              'graph-opt pipeline: "bf16" runs white-listed ops '
+              '(matmul/conv/attention/RNN gates — registry.AMP_WHITE) '
+              'in bfloat16 with f32 master weights in the Scope; "f16" '
+              'uses float16 and additionally wires dynamic loss '
+              'scaling (scale the loss, unscale grads, skip the '
+              'optimizer step on non-finite grads, grow/backoff the '
+              'scale).  "0" (default) is off and bitwise-identical to '
+              'not having the pass.  Re-read on every plan build and '
+              'part of the executor plan-cache key, so flips take '
+              'effect without a restart')
+DEFINE_float('amp_init_loss_scale', 32768.0,
+             'f16 mode: initial dynamic loss scale (2^15)')
+DEFINE_int('amp_incr_every_n_steps', 1000,
+           'f16 mode: consecutive finite steps before the loss scale '
+           'doubles')
+DEFINE_int('amp_decr_every_n_nan_or_inf', 2,
+           'f16 mode: consecutive non-finite steps before the loss '
+           'scale halves')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -144,21 +144,33 @@ def bucket_sizes(max_batch):
 
 
 def export_bucketed(dir_path, feed_specs, target_vars, executor=None,
-                    main_program=None, scope=None, max_batch=8):
+                    main_program=None, scope=None, max_batch=8,
+                    amp=None):
     """Export one shape-specialized StableHLO artifact per bucket size.
 
     :param feed_specs: {feed_name: per-request example shape WITHOUT the
         batch axis} — bucket b exports at shape (b,) + example_shape.
+    :param amp: scoped PADDLE_TPU_AMP override for these exports:
+        'bf16'/'f16' bakes the AMP-rewritten program (white-listed ops
+        in low precision, f32 weights cast once at the graph edge) into
+        every bucket's artifact; '0' forces full precision; None
+        (default) honours the ambient flag.  The override is
+        PROCESS-GLOBAL for the duration of the export (amp_guard
+        mutates os.environ, which every concurrent plan build reads) —
+        export before serving/training threads start, the way
+        from_program's warmup already sequences it.
     :returns: {bucket_size: artifact path}, ready for
         :class:`BatchingInferenceServer`.
     """
+    from ..transpiler.amp import amp_guard
     paths = {}
-    for b in bucket_sizes(max_batch):
-        shapes = {n: (b,) + tuple(s) for n, s in feed_specs.items()}
-        p = os.path.join(dir_path, 'bucket_%d.stablehlo' % b)
-        export_inference(p, shapes, target_vars, executor=executor,
-                         main_program=main_program, scope=scope)
-        paths[b] = p
+    with amp_guard(amp):
+        for b in bucket_sizes(max_batch):
+            shapes = {n: (b,) + tuple(s) for n, s in feed_specs.items()}
+            p = os.path.join(dir_path, 'bucket_%d.stablehlo' % b)
+            export_inference(p, shapes, target_vars, executor=executor,
+                             main_program=main_program, scope=scope)
+            paths[b] = p
     return paths
 
 

@@ -3,7 +3,7 @@
 Reference parity: paddle/operators/* (one jax function per reference op
 kernel family; see SURVEY.md §2.2).
 """
-from . import (activations, attention, beam_search, chunked_ce,
+from . import (activations, amp_ops, attention, beam_search, chunked_ce,
                collective_ops, common, control_flow, conv, crf, ctc,
                detection, embedding, loss, math, metrics, misc, norm,
                optim_ops, pool, random, rnn, sequence, tensor_array,

@@ -1,0 +1,83 @@
+"""AMP dynamic-loss-scaling ops (f16 mode of the transpiler/amp.py pass).
+
+Reference parity: paddle/operators' later check_finite_and_unscale +
+update_loss_scaling pair (Micikevicius et al. 2018, "Mixed Precision
+Training"): the loss is multiplied by a scale before backward so small
+f16 gradients don't flush to zero, gradients are divided back down
+before clipping/regularization/apply, a step whose gradients contain
+inf/nan is skipped wholesale (the executor gates optimize-role ops on
+FoundInfinite — see executor._run_one), and the scale grows after N
+consecutive finite steps / shrinks after M consecutive overflows.
+
+Both ops are pure jnp over their inputs — the grow/backoff counters and
+the scale are ordinary persistable [1] vars, so under Executor.run_steps
+they ride the lax.scan carry like any optimizer state.
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
+from .common import first
+
+
+def _all_finite(x):
+    return jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+
+
+@register_op('check_finite_and_unscale')
+def _check_finite_and_unscale(ctx, ins, attrs):
+    """Out[i] = X[i] / Scale; FoundInfinite = any X has inf/nan (OR'd
+    with the optional FoundAcc input so multi-minimize programs chain
+    one check per autodiff into a single verdict).  SelectedRows grads
+    unscale their values in place (rows untouched)."""
+    scale = first(ins, 'Scale').astype(jnp.float32).reshape(())
+    inv = 1.0 / scale
+    found = jnp.zeros((), bool)
+    for acc in ins.get('FoundAcc', []):
+        found = found | jnp.reshape(acc, ()).astype(bool)
+    outs = []
+    for g in ins.get('X', []):
+        if isinstance(g, SelectedRows):
+            v = g.values.astype(jnp.float32)
+            found = found | ~_all_finite(v)
+            outs.append(SelectedRows(g.rows,
+                                     (v * inv).astype(g.values.dtype),
+                                     g.height))
+        else:
+            found = found | ~_all_finite(g)
+            outs.append((g.astype(jnp.float32) * inv).astype(g.dtype))
+    return {'Out': outs, 'FoundInfinite': [jnp.reshape(found, (1,))]}
+
+
+@register_op('update_loss_scale')
+def _update_loss_scale(ctx, ins, attrs):
+    """Grow/backoff the dynamic loss scale.  Non-finite step: bad+1,
+    good=0, and after decr_every_n_nan_or_inf consecutive overflows the
+    scale halves (floored at 1.0).  Finite step: good+1, bad=0, and
+    after incr_every_n_steps consecutive finite steps the scale doubles
+    (capped at 2^31).  SkippedSteps counts overflowed (gated-away)
+    steps cumulatively for the observability layer."""
+    found = jnp.reshape(first(ins, 'FoundInfinite'), ()).astype(bool)
+    scale = first(ins, 'LossScale').astype(jnp.float32).reshape(())
+    good = first(ins, 'GoodSteps').reshape(()).astype(jnp.int32)
+    bad = first(ins, 'BadSteps').reshape(()).astype(jnp.int32)
+    skipped = first(ins, 'SkippedSteps').reshape(()).astype(jnp.int32)
+    incr_every = int(attrs.get('incr_every_n_steps', 1000))
+    decr_every = int(attrs.get('decr_every_n_nan_or_inf', 2))
+    incr_ratio = float(attrs.get('incr_ratio', 2.0))
+    decr_ratio = float(attrs.get('decr_ratio', 0.5))
+    bad_new = jnp.where(found, bad + 1, 0)
+    good_new = jnp.where(found, 0, good + 1)
+    shrink = bad_new >= decr_every
+    grow = good_new >= incr_every
+    scale_new = jnp.where(
+        shrink, jnp.maximum(scale * decr_ratio, 1.0),
+        jnp.where(grow, jnp.minimum(scale * incr_ratio, 2.0 ** 31),
+                  scale))
+    return {
+        'LossScaleOut': [scale_new.reshape((1,))],
+        'GoodStepsOut': [jnp.where(grow, 0, good_new).reshape((1,))],
+        'BadStepsOut': [jnp.where(shrink, 0, bad_new).reshape((1,))],
+        'SkippedStepsOut': [(skipped +
+                             found.astype(jnp.int32)).reshape((1,))],
+    }

@@ -82,7 +82,14 @@ def _cast(ctx, ins, attrs):
         dtype = np.int32  # x64 disabled on TPU
     elif dtype == np.float64:
         dtype = np.float32
-    return out(first(ins, 'X').astype(dtype))
+    x = first(ins, 'X')
+    if getattr(x, 'dtype', None) == np.dtype(dtype):
+        # same-dtype cast is the identity: pass the value through so it
+        # contributes zero HLO and its VJP is exactly the identity (the
+        # AMP weaver leans on both — a cast-to-bf16 of an already-bf16
+        # value must not perturb the graph)
+        return out(x)
+    return out(x.astype(dtype))
 
 
 @register_op('assign')

@@ -5,6 +5,19 @@ Adagrad, Adam, Adamax, DecayedAdagrad; plus Adadelta/RMSProp/Ftrl whose ops
 exist in paddle/operators).  minimize() = functional autodiff
 (core/backward.py) + clip + regularization + per-param update ops; the whole
 thing compiles into the same single XLA program as the forward pass.
+
+AMP contract (PADDLE_TPU_AMP — transpiler/amp.py): every optimizer op is
+black-listed, so updates always apply to the f32 master weights.  The pass
+never renames a Parameter or an accumulator; under bf16/f16 the gradients
+reaching the `Grad` slot are already unscaled f32 (the autodiff casts to
+the leaf dtype, check_finite_and_unscale divides the loss scale back out
+upstream of clip/regularization), and in f16 mode each optimize-role op is
+gated on the overflow flag (`amp_gate_var` attr, applied by
+executor._run_one) so a non-finite step leaves params, moments, and the
+beta-pow/global-step counters untouched (per loss-group in
+multi-minimize programs — each group gates on the verdicts of its own
+and earlier autodiffs).  Nothing here needs to know any of that — which
+is the point.
 """
 from collections import defaultdict
 

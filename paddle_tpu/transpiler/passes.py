@@ -155,7 +155,8 @@ def _resolve_level(level):
 def _is_effectful(op):
     if op.type in EFFECTFUL_OPS:
         return True
-    registered, _rng, needs_env = op_traits(op.type)
+    traits = op_traits(op.type)
+    registered, needs_env = traits.registered, traits.needs_env
     if needs_env:
         return True  # future env ops default to barrier even if the
         # EFFECTFUL_OPS list lags (the sweep test keeps it in sync)

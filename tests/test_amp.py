@@ -1,0 +1,530 @@
+"""Automatic mixed-precision tests (transpiler/amp.py + PADDLE_TPU_AMP).
+
+Covers: mode resolution and the plan-key component; the datatypes
+helpers AMP leans on; cast-op pass-through and round-trip/grad-dtype
+contracts; golden cast-insertion lists (no double casts); the
+default-off identity + plan-cache invalidation on flag flips; bf16
+training parity on MNIST and LSTM-LM with f32 master weights; f16
+dynamic loss scaling (unit ops, overflow skip-step, scan-carried
+state); the tools/check_amp_lists.py static check; and AMP-rewritten
+serving exports.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core import datatypes, registry
+from paddle_tpu.core.program import reset_unique_name_guard
+from paddle_tpu.transpiler import amp
+
+
+def _set_amp(mode):
+    if mode:
+        os.environ['PADDLE_TPU_AMP'] = mode
+    else:
+        os.environ.pop('PADDLE_TPU_AMP', None)
+
+
+@pytest.fixture(autouse=True)
+def _amp_env_clean():
+    old = os.environ.get('PADDLE_TPU_AMP')
+    yield
+    if old is None:
+        os.environ.pop('PADDLE_TPU_AMP', None)
+    else:
+        os.environ['PADDLE_TPU_AMP'] = old
+
+
+def _train(build, feed, mode, steps, seed=7):
+    """Train `steps` executor steps under an AMP mode in a fresh scope;
+    returns (per-step losses, {param: scope dtype}, last report)."""
+    _set_amp(mode)
+    scope = fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        main_p, startup, loss = build()
+        main_p.random_seed = seed
+        startup.random_seed = seed
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+        dtypes = {p.name: np.asarray(scope.find_var(p.name)).dtype
+                  for p in main_p.all_parameters()}
+        return losses, dtypes, exe.last_graph_opt_report
+
+
+def _build_mnist_mlp(lr=0.05):
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            from paddle_tpu.models import mnist
+            _img, _lbl, _pred, avg_cost, _acc = mnist.build('mlp')
+            fluid.optimizer.SGDOptimizer(lr).minimize(avg_cost)
+        return main_p, startup, avg_cost
+    return build
+
+
+def _mnist_feed(batch=64):
+    rng = np.random.default_rng(0)
+    return {'img': rng.normal(size=(batch, 1, 28, 28)).astype(np.float32),
+            'label': rng.integers(0, 10, (batch, 1)).astype(np.int32)}
+
+
+# ---------------------------------------------------------------------------
+# mode resolution / flags plumbing
+# ---------------------------------------------------------------------------
+
+def test_resolve_mode():
+    assert amp.resolve_mode('0') is None
+    assert amp.resolve_mode('') is None
+    assert amp.resolve_mode('off') is None
+    assert amp.resolve_mode('bf16') == 'bf16'
+    assert amp.resolve_mode('BFLOAT16') == 'bf16'
+    assert amp.resolve_mode('fp16') == 'f16'
+    assert amp.resolve_mode('float16') == 'f16'
+    with pytest.raises(ValueError):
+        amp.resolve_mode('f8')
+    _set_amp(None)
+    assert amp.resolve_mode() is None  # flag default is off
+    _set_amp('bf16')
+    assert amp.resolve_mode() == 'bf16'
+
+
+def test_plan_key_component():
+    _set_amp(None)
+    assert amp.plan_key_component() is None
+    _set_amp('bf16')
+    assert amp.plan_key_component() == ('bf16',)
+    _set_amp('f16')
+    key = amp.plan_key_component()
+    assert key[0] == 'f16' and len(key) == 4  # mode + loss-scale knobs
+
+
+def test_amp_guard_restores_env():
+    _set_amp(None)
+    with amp.amp_guard('bf16'):
+        assert os.environ['PADDLE_TPU_AMP'] == 'bf16'
+    assert 'PADDLE_TPU_AMP' not in os.environ
+    _set_amp('f16')
+    with amp.amp_guard('0'):
+        assert amp.resolve_mode() is None
+    assert os.environ['PADDLE_TPU_AMP'] == 'f16'
+    with pytest.raises(ValueError):
+        with amp.amp_guard('f8'):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# datatypes helpers (bf16/fp16 alias edge cases included)
+# ---------------------------------------------------------------------------
+
+def test_datatypes_low_precision_and_aliases():
+    assert datatypes.is_low_precision('bfloat16')
+    assert datatypes.is_low_precision('bf16')       # alias
+    assert datatypes.is_low_precision('fp16')       # alias
+    assert datatypes.is_low_precision('float16')
+    assert not datatypes.is_low_precision('float32')
+    assert not datatypes.is_low_precision('fp32')
+    assert datatypes.convert_dtype('bf16') == 'bfloat16'
+    assert datatypes.convert_dtype('fp16') == 'float16'
+    assert datatypes.convert_dtype(datatypes.bfloat16) == 'bfloat16'
+    with pytest.raises(ValueError):
+        datatypes.is_low_precision('b16')
+
+
+def test_promote_float_dtype():
+    assert datatypes.promote_float_dtype('bf16', 'float32') == 'float32'
+    assert datatypes.promote_float_dtype('bfloat16', 'bf16') == 'bfloat16'
+    assert datatypes.promote_float_dtype('float16', 'float16') == 'float16'
+    # bf16 and f16 don't order against each other: promote to f32
+    assert datatypes.promote_float_dtype('bf16', 'fp16') == 'float32'
+    assert datatypes.promote_float_dtype('float64', 'bf16') == 'float64'
+    with pytest.raises(ValueError):
+        datatypes.promote_float_dtype('int32', 'float32')
+
+
+# ---------------------------------------------------------------------------
+# cast op contracts the weaver relies on
+# ---------------------------------------------------------------------------
+
+def test_cast_same_dtype_is_passthrough():
+    impl = registry.get_op_impl('cast')
+    x = jnp.arange(6, dtype=jnp.float32)
+    (y,) = impl.compute(None, {'X': [x]}, {'out_dtype': 'float32'})['Out']
+    assert y is x  # identity, zero HLO
+    xb = x.astype(jnp.bfloat16)
+    (yb,) = impl.compute(None, {'X': [xb]},
+                         {'out_dtype': 'bfloat16'})['Out']
+    assert yb is xb
+
+
+def test_cast_bf16_f32_roundtrip_and_grad_dtype():
+    impl = registry.get_op_impl('cast')
+    x = jnp.asarray(np.linspace(-3, 3, 17), jnp.float32)
+    (down,) = impl.compute(None, {'X': [x]},
+                           {'out_dtype': 'bfloat16'})['Out']
+    assert down.dtype == jnp.bfloat16
+    (up,) = impl.compute(None, {'X': [down]},
+                         {'out_dtype': 'float32'})['Out']
+    assert up.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(up), np.asarray(x), rtol=1e-2)
+
+    # the master-weight contract: d/dx sum(cast(x, bf16)) must come back
+    # as f32 (the VJP of the down-cast re-casts the cotangent up)
+    def f(v):
+        (lo,) = impl.compute(None, {'X': [v]},
+                             {'out_dtype': 'bfloat16'})['Out']
+        return jnp.sum(lo.astype(jnp.float32))
+
+    g = jax.grad(f)(x)
+    assert g.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(g), 1.0, rtol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# the weaver: golden cast lists, identity when off, cache keys
+# ---------------------------------------------------------------------------
+
+def test_golden_cast_list_mnist_mlp():
+    with reset_unique_name_guard():
+        main_p, _startup, _loss = _build_mnist_mlp(lr=0.1)()
+    p2, rep = amp.apply_amp(main_p, mode='bf16')
+    assert rep['mode'] == 'bf16' and not rep['loss_scaling']
+    # golden: the image + every fc weight/bias casts down ONCE at the
+    # graph edge; one f32 up-cast at the softmax boundary.  No value is
+    # cast twice to the same precision (the CSE contract).
+    assert rep['casts'] == [
+        ('img', 'bfloat16'),
+        ('fc_0.w_0', 'bfloat16'), ('fc_0.b_0', 'bfloat16'),
+        ('fc_1.w_0', 'bfloat16'), ('fc_1.b_0', 'bfloat16'),
+        ('fc_2.w_0', 'bfloat16'), ('fc_2.b_0', 'bfloat16'),
+        ('fc_2.tmp_1', 'float32'),
+    ]
+    assert len(set(rep['casts'])) == len(rep['casts'])
+    assert rep['casts_inserted'] == 8
+    assert rep['ops_lowered'] == 8  # 3 mul + 3 add + 2 relu
+    types = [op.type for op in p2.global_block().ops]
+    assert types.count('cast') == 8
+    assert types.index('softmax') > types.index('mul')
+    # the user's program is untouched
+    assert 'cast' not in [op.type for op in main_p.global_block().ops]
+    # master weights: every Parameter keeps its f32 declaration
+    for p in p2.all_parameters():
+        assert p.dtype == 'float32'
+
+
+def test_foreign_low_dtype_promotes_to_f32():
+    """A manual bf16 value under an f16 weave must promote to f32, not
+    follow either 16-bit dtype: bf16 and f16 don't order against each
+    other (promote_float_dtype lattice) and jax itself promotes the
+    pair to f32 — declaring the output f16 would lie to the donation
+    analysis and seed wrong casts downstream."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data(name='amp_mix_x', shape=[4],
+                              dtype='float32')
+        xb = fluid.layers.cast(x=x, dtype='bfloat16')
+        y = fluid.layers.data(name='amp_mix_y', shape=[4],
+                              dtype='float32')
+        z = fluid.layers.elementwise_add(xb, y)
+    p2, rep = amp.apply_amp(main, mode='f16')
+    # the grey add saw {bf16, f32}: the bf16 input casts UP, nothing
+    # casts to f16, and the output declares f32
+    assert (xb.name, 'float32') in rep['casts']
+    assert not any(dt == 'float16' for _, dt in rep['casts'])
+    assert p2.global_block().vars[z.name].dtype == 'float32'
+
+
+def test_amp_off_is_bitwise_identity():
+    build, feed = _build_mnist_mlp(), _mnist_feed(16)
+    l_unset, _, rep_unset = _train(build, feed, None, 2)
+    l_zero, _, rep_zero = _train(build, feed, '0', 2)
+    assert l_unset == l_zero  # bitwise: both resolve to the same plan
+    assert 'amp' not in (rep_unset or {})
+    assert 'amp' not in (rep_zero or {})
+
+
+def test_flag_flip_invalidates_plan_cache():
+    build, feed = _build_mnist_mlp(), _mnist_feed(8)
+    scope = fluid.core.scope.Scope()
+    _set_amp(None)
+    with fluid.scope_guard(scope):
+        main_p, startup, loss = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        n_plans = len(exe._cache)
+        assert 'amp' not in (exe.last_graph_opt_report or {})
+        # flip ON: a new plan must be built (never a stale f32 trace)
+        _set_amp('bf16')
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        assert len(exe._cache) == n_plans + 1
+        assert exe.last_graph_opt_report['amp']['ops_lowered'] > 0
+        # flip OFF again: the original plan serves from cache, and the
+        # report tracks the hit plan (no amp section)
+        _set_amp(None)
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        assert len(exe._cache) == n_plans + 1
+        assert 'amp' not in (exe.last_graph_opt_report or {})
+
+
+# ---------------------------------------------------------------------------
+# bf16 training parity (f32 master weights in the Scope)
+# ---------------------------------------------------------------------------
+
+def test_bf16_parity_mnist():
+    build, feed = _build_mnist_mlp(), _mnist_feed()
+    l32, d32, _ = _train(build, feed, None, 6)
+    lbf, dbf, rep = _train(build, feed, 'bf16', 6)
+    np.testing.assert_allclose(lbf[-1], l32[-1], rtol=2e-2)
+    # master weights stay f32 on device under AMP
+    assert set(dbf.values()) == {np.dtype(np.float32)}
+    assert set(d32.values()) == {np.dtype(np.float32)}
+    assert rep['amp']['ops_lowered'] > 0
+    assert not rep['amp']['loss_scaling']  # bf16 needs no scaling
+
+
+def test_bf16_parity_lstm_lm():
+    batch, seq, vocab = 4, 8, 60
+    rng = np.random.default_rng(0)
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            from paddle_tpu.models import rnn_lm
+            _s, _t, avg_cost = rnn_lm.build(
+                vocab_size=vocab, emb_dim=16, hidden_dim=32,
+                num_layers=1)
+            fluid.optimizer.AdagradOptimizer(0.1).minimize(avg_cost)
+        return main_p, startup, avg_cost
+
+    ln = np.full((batch,), seq, np.int32)
+
+    def mk():
+        return rng.integers(1, vocab, (batch, seq, 1)).astype(np.int32)
+
+    feed = {'src': (mk(), ln), 'target': (mk(), ln)}
+    l32, d32, _ = _train(build, feed, None, 5)
+    lbf, dbf, rep = _train(build, feed, 'bf16', 5)
+    np.testing.assert_allclose(lbf[-1], l32[-1], rtol=2e-2)
+    assert set(dbf.values()) == {np.dtype(np.float32)}
+    assert rep['amp']['ops_lowered'] > 0
+    # something actually lowered to bf16 (the LSTM/fc/vocab-head path)
+    assert any(dt == 'bfloat16' for _, dt in rep['amp']['casts'])
+
+
+# ---------------------------------------------------------------------------
+# f16 dynamic loss scaling
+# ---------------------------------------------------------------------------
+
+def test_check_finite_and_unscale_unit():
+    impl = registry.get_op_impl('check_finite_and_unscale')
+    scale = jnp.asarray([4.0], jnp.float32)
+    g1 = jnp.asarray([8.0, 12.0], jnp.float32)
+    outs = impl.compute(None, {'X': [g1], 'Scale': [scale]}, {})
+    np.testing.assert_array_equal(np.asarray(outs['Out'][0]), [2.0, 3.0])
+    assert not bool(np.asarray(outs['FoundInfinite'][0])[0])
+    g_bad = jnp.asarray([1.0, np.inf], jnp.float32)
+    outs = impl.compute(None, {'X': [g1, g_bad], 'Scale': [scale]}, {})
+    assert bool(np.asarray(outs['FoundInfinite'][0])[0])
+    # FoundAcc chains a previous check's verdict in
+    acc = jnp.asarray([True])
+    outs = impl.compute(None, {'X': [g1], 'Scale': [scale],
+                               'FoundAcc': [acc]}, {})
+    assert bool(np.asarray(outs['FoundInfinite'][0])[0])
+
+
+def test_update_loss_scale_unit():
+    impl = registry.get_op_impl('update_loss_scale')
+
+    def step(found, scale, good, bad, skipped, **knobs):
+        outs = impl.compute(None, {
+            'FoundInfinite': [jnp.asarray([found])],
+            'LossScale': [jnp.asarray([scale], jnp.float32)],
+            'GoodSteps': [jnp.asarray([good], jnp.int32)],
+            'BadSteps': [jnp.asarray([bad], jnp.int32)],
+            'SkippedSteps': [jnp.asarray([skipped], jnp.int32)]}, knobs)
+        return tuple(float(np.asarray(outs[k][0])[0]) for k in
+                     ('LossScaleOut', 'GoodStepsOut', 'BadStepsOut',
+                      'SkippedStepsOut'))
+
+    # finite step grows the good counter; hits incr_every -> doubles
+    assert step(False, 1024.0, 0, 0, 0,
+                incr_every_n_steps=2) == (1024.0, 1.0, 0.0, 0.0)
+    assert step(False, 1024.0, 1, 0, 0,
+                incr_every_n_steps=2) == (2048.0, 0.0, 0.0, 0.0)
+    # overflow: bad counter, skip count; hits decr_every -> halves
+    assert step(True, 1024.0, 5, 0, 0,
+                decr_every_n_nan_or_inf=2) == (1024.0, 0.0, 1.0, 1.0)
+    assert step(True, 1024.0, 0, 1, 1,
+                decr_every_n_nan_or_inf=2) == (512.0, 0.0, 0.0, 2.0)
+    # the scale floors at 1.0
+    assert step(True, 1.0, 0, 1, 0,
+                decr_every_n_nan_or_inf=2)[0] == 1.0
+
+
+def test_f16_loss_scaling_trains_and_carries_state():
+    build, feed = _build_mnist_mlp(lr=0.01), _mnist_feed(16)
+    _set_amp('f16')
+    scope = fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        main_p, startup, loss = build()
+        main_p.random_seed = 7
+        startup.random_seed = 7
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+        rep = exe.last_graph_opt_report['amp']
+        assert rep['mode'] == 'f16' and rep['loss_scaling']
+        assert float(np.asarray(
+            scope.find_var(amp.LOSS_SCALE_VAR))[0]) == 32768.0
+        assert int(np.asarray(
+            scope.find_var(amp.GOOD_STEPS_VAR))[0]) == 3
+        # run_steps: the scale state rides the lax.scan carry
+        outs = exe.run_steps(main_p, feed=feed, fetch_list=[loss],
+                             repeat=4)
+        assert np.isfinite(np.asarray(outs[0])).all()
+        assert int(np.asarray(
+            scope.find_var(amp.GOOD_STEPS_VAR))[0]) == 7
+        # master weights stay f32
+        for p in main_p.all_parameters():
+            assert np.asarray(scope.find_var(p.name)).dtype == np.float32
+
+
+def test_f16_overflow_skips_step_and_backs_off():
+    build = _build_mnist_mlp(lr=0.01)
+    feed = _mnist_feed(16)
+    bad_feed = dict(feed, img=np.full_like(feed['img'], 1e38))
+    _set_amp('f16')
+    os.environ['PADDLE_TPU_AMP_DECR_EVERY_N_NAN_OR_INF'] = '1'
+    try:
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main_p, startup, loss = build()
+            wname = main_p.all_parameters()[0].name
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+            w0 = np.asarray(scope.find_var(wname)).copy()
+            exe.run(main_p, feed=bad_feed, fetch_list=[loss])
+            # the whole step was skipped: params bitwise-unchanged,
+            # scale backed off, skip counter advanced
+            w1 = np.asarray(scope.find_var(wname))
+            assert np.array_equal(w0, w1)
+            assert float(np.asarray(
+                scope.find_var(amp.LOSS_SCALE_VAR))[0]) == 16384.0
+            assert int(np.asarray(
+                scope.find_var(amp.SKIPPED_STEPS_VAR))[0]) == 1
+            # and training recovers on the next good batch
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+            w2 = np.asarray(scope.find_var(wname))
+            assert not np.array_equal(w1, w2)
+    finally:
+        os.environ.pop('PADDLE_TPU_AMP_DECR_EVERY_N_NAN_OR_INF', None)
+
+
+@pytest.mark.parametrize('opt', ['adagrad', 'momentum'])
+def test_f16_sparse_grads_skip_step(opt):
+    """SelectedRows grads under f16 skip-step.  Row-wise optimizers
+    (adagrad) gate at the IDS level (rows -> the >=height sentinel on
+    overflow) so the donated in-place table kernels stay in place;
+    densifying optimizers (momentum) keep the output-where — either
+    way an overflowed step leaves the table AND the state accumulator
+    bitwise-unchanged, and training resumes on the next good batch."""
+    _set_amp('f16')
+    os.environ['PADDLE_TPU_AMP_DECR_EVERY_N_NAN_OR_INF'] = '1'
+    rng = np.random.default_rng(3)
+    try:
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main_p, startup = fluid.Program(), fluid.Program()
+            main_p.random_seed = startup.random_seed = 5
+            with fluid.program_guard(main_p, startup):
+                ids = fluid.layers.data(name='ids', shape=[1],
+                                        dtype='int64')
+                emb = fluid.layers.embedding(input=ids, size=[40, 8],
+                                             is_sparse=True)
+                y = fluid.layers.data(name='y', shape=[8],
+                                      dtype='float32')
+                loss = fluid.layers.mean(
+                    x=fluid.layers.square_error_cost(input=emb,
+                                                     label=y))
+                if opt == 'adagrad':
+                    fluid.optimizer.AdagradOptimizer(0.1).minimize(loss)
+                else:
+                    fluid.optimizer.MomentumOptimizer(
+                        0.1, 0.9).minimize(loss)
+            wname = main_p.all_parameters()[0].name
+            acc = '_moment' if opt == 'adagrad' else '_velocity'
+            mom_name = [v.name for v in main_p.list_vars()
+                        if v.persistable and acc in v.name][0]
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = {'ids': rng.integers(0, 40, (6, 1)).astype(np.int32),
+                    'y': rng.normal(size=(6, 8)).astype(np.float32)}
+            bad = dict(feed, y=np.full((6, 8), 1e38, np.float32))
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+            w1 = np.asarray(scope.find_var(wname)).copy()
+            m1 = np.asarray(scope.find_var(mom_name)).copy()
+            exe.run(main_p, feed=bad, fetch_list=[loss])
+            assert np.array_equal(w1,
+                                  np.asarray(scope.find_var(wname)))
+            assert np.array_equal(m1,
+                                  np.asarray(scope.find_var(mom_name)))
+            assert float(np.asarray(
+                scope.find_var(amp.LOSS_SCALE_VAR))[0]) == 16384.0
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+            assert not np.array_equal(
+                w1, np.asarray(scope.find_var(wname)))
+    finally:
+        os.environ.pop('PADDLE_TPU_AMP_DECR_EVERY_N_NAN_OR_INF', None)
+
+
+# ---------------------------------------------------------------------------
+# tooling + serving
+# ---------------------------------------------------------------------------
+
+def test_check_amp_lists_tool():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'check_amp_lists.py')
+    spec = importlib.util.spec_from_file_location('check_amp_lists', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
+
+
+def test_export_bucketed_amp(tmp_path):
+    from paddle_tpu.inference import export_bucketed
+    from paddle_tpu.inference.serving import load_exported
+    scope = fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            y = fluid.layers.fc(input=x, size=4, act='relu')
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        p32 = export_bucketed(str(tmp_path / 'f32'), {'x': (8,)}, [y],
+                              executor=exe, main_program=main_p,
+                              scope=scope, max_batch=2, amp='0')
+        pbf = export_bucketed(str(tmp_path / 'bf16'), {'x': (8,)}, [y],
+                              executor=exe, main_program=main_p,
+                              scope=scope, max_batch=2, amp='bf16')
+        # the bf16 export rewrote the traced program
+        assert exe.last_graph_opt_report['amp']['ops_lowered'] > 0
+    feed = {'x': np.linspace(-1, 1, 16).reshape(2, 8).astype(np.float32)}
+    out32 = np.asarray(load_exported(p32[2])(feed)[0])
+    outbf = np.asarray(load_exported(pbf[2])(feed)[0])
+    assert out32.dtype == np.float32
+    np.testing.assert_allclose(outbf.astype(np.float32), out32,
+                               rtol=5e-2, atol=1e-2)

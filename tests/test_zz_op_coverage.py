@@ -31,7 +31,7 @@ def test_graph_opt_classification_consistent_with_registry():
     from paddle_tpu.transpiler import passes
 
     for t in registry.registered_ops():
-        registered, stateful_rng, needs_env = registry.op_traits(t)
+        registered, stateful_rng, needs_env, _amp = registry.op_traits(t)
         assert registered
         if needs_env:
             assert t in passes.EFFECTFUL_OPS, (
@@ -47,6 +47,48 @@ def test_graph_opt_classification_consistent_with_registry():
     for t in passes.CSE_OPS | passes.EFFECTFUL_OPS:
         assert registry.has_op(t), (
             "whitelist entry %r is not a registered op" % t)
+
+
+def test_amp_classification_covers_every_op_exactly_once():
+    """Every registered op lands in exactly one AMP class (white, black,
+    or grey-by-default) — a new op can't silently bypass the lists.
+    List hygiene (entries registered, white/black disjoint, white ops
+    lowerable, optimizer family black) lives in ONE place —
+    tools/check_amp_lists.check(), also exercised by
+    tests/test_amp.py — so the rules can't fork; this sweep keeps only
+    the op_traits()-vs-lists consistency it alone covers."""
+    for t in registry.registered_ops():
+        cls = registry.op_traits(t).amp
+        assert cls == registry.amp_class(t)
+        assert cls in ('white', 'black', 'grey')
+        assert (cls == 'white') == (t in registry.AMP_WHITE)
+        assert (cls == 'black') == (t in registry.AMP_BLACK)
+    assert registry.amp_class('no_such_op') == 'grey'
+
+
+def test_amp_weaver_survives_every_registered_op():
+    """Sweep: one synthetic single-op program per registered op type
+    through the bf16 weaver.  No op may crash it, and with
+    unknown-dtype inputs no casts may appear (the weaver only touches
+    values whose precision it has proven)."""
+    from paddle_tpu.core.program import Program
+    from paddle_tpu.transpiler import amp
+
+    for t in registry.registered_ops():
+        p = Program()
+        p.global_block().append_op(
+            type=t,
+            inputs={'X': ['swp_in_a'], 'Y': ['swp_in_b']},
+            outputs={'Out': ['swp_out_%s' % t]},
+            attrs={})
+        opt, rep = amp.apply_amp(p, mode='bf16')
+        survivors = [op.type for op in opt.global_block().ops]
+        assert t in survivors, (
+            "AMP weaver dropped an op from a single-%r program: %s"
+            % (t, survivors))
+        assert rep['casts_inserted'] == 0, (
+            "AMP weaver cast unknown-dtype inputs of %r: %s"
+            % (t, rep['casts']))
 
 
 def test_graph_opt_pipeline_survives_every_registered_op():

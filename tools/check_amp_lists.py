@@ -1,0 +1,86 @@
+"""Static consistency check for the AMP white/black op lists
+(core/registry.py AMP_WHITE / AMP_BLACK).
+
+Catches list rot when ops are renamed or removed: every list entry must
+name a registered op, the lists must be disjoint, white ops must be
+lowerable (no env access, not pipeline barriers), and the op families
+whose classification the AMP numerics contract depends on (optimizer
+updates black, AMP machinery black) must not drift.
+
+Runs standalone (``python tools/check_amp_lists.py``, exit 1 on
+failure) and in tier-1 via tests/test_amp.py, which imports ``check()``
+so CI pays no extra interpreter start.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the optimizer-update family: these apply steps to the f32 master
+# weights, so lowering any of them would break the master-weight
+# guarantee outright
+_OPTIMIZER_OPS = (
+    'sgd', 'momentum', 'adam', 'adamax', 'adagrad', 'decayed_adagrad',
+    'adadelta', 'rmsprop', 'ftrl', 'proximal_gd', 'proximal_adagrad',
+)
+_AMP_MACHINERY_OPS = ('check_finite_and_unscale', 'update_loss_scale')
+
+
+def check():
+    """Returns a list of human-readable error strings (empty = OK)."""
+    import paddle_tpu  # noqa: F401 — registers every op
+    from paddle_tpu.core import registry
+    from paddle_tpu.transpiler import passes
+
+    errors = []
+    reg = set(registry.registered_ops())
+    for list_name, lst in (('AMP_WHITE', registry.AMP_WHITE),
+                           ('AMP_BLACK', registry.AMP_BLACK)):
+        for t in sorted(set(lst) - reg):
+            errors.append(
+                "%s entry %r is not a registered op (renamed or "
+                "removed?)" % (list_name, t))
+    for t in sorted(registry.AMP_WHITE & registry.AMP_BLACK):
+        errors.append("op %r is in both AMP_WHITE and AMP_BLACK" % t)
+    for t in sorted(registry.AMP_WHITE & reg):
+        traits = registry.op_traits(t)
+        if traits.needs_env or t in passes.EFFECTFUL_OPS:
+            errors.append(
+                "AMP_WHITE op %r is an env/effectful barrier — the "
+                "weaver can never lower it, the entry is dead" % t)
+    for t in _OPTIMIZER_OPS:
+        if t in reg and registry.amp_class(t) != 'black':
+            errors.append(
+                "optimizer op %r must be AMP black (f32 master "
+                "weights), got %r" % (t, registry.amp_class(t)))
+    for t in _AMP_MACHINERY_OPS:
+        if t in reg and registry.amp_class(t) != 'black':
+            errors.append(
+                "AMP machinery op %r must be AMP black, got %r"
+                % (t, registry.amp_class(t)))
+    # every registered op is classified exactly once (the partition is
+    # white / black / grey-by-default)
+    for t in sorted(reg):
+        cls = registry.amp_class(t)
+        n = (t in registry.AMP_WHITE) + (t in registry.AMP_BLACK)
+        if n > 1 or (n == 1) != (cls in ('white', 'black')):
+            errors.append("op %r classification is ambiguous" % t)
+    return errors
+
+
+def main():
+    errors = check()
+    for e in errors:
+        print("check_amp_lists: %s" % e, file=sys.stderr)
+    if errors:
+        return 1
+    from paddle_tpu.core import registry
+    print("check_amp_lists: OK (%d white, %d black, %d registered)"
+          % (len(registry.AMP_WHITE), len(registry.AMP_BLACK),
+             len(registry.registered_ops())))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
