@@ -157,7 +157,8 @@ def main():
               note='batch=%d slots=%d dim=%d (criteo-class) '
                    'sparse_apply=%s'
                    % (batch, num_slots, sparse_dim, sparse_apply_mode()),
-              compile_stats=True)
+              compile_stats=True,
+              step_breakdown=True)
 
     # scatter-apply micro: XLA vs Pallas across table heights
     _sparse_apply_micro(tpu)

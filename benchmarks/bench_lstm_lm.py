@@ -37,7 +37,8 @@ def main():
               steps=100 if on_tpu() else 3,
               note='batch=%d seq=%d vocab=%d' % (batch, seq, vocab),
               dtype='bfloat16',
-              compile_stats=True)
+              compile_stats=True,
+              step_breakdown=True)
     # f32 build through the AMP pass: amp=off is the f32 baseline,
     # amp=bf16 lowers the LSTM gates / fc / vocab head via the lists
     run_bench('stacked_lstm_tokens_per_sec', batch * seq,

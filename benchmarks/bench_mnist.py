@@ -29,11 +29,15 @@ def main():
     # K=500 1.42M; b4096 regresses to 930k).
     # amp_compare: two rows (amp=off / amp=bf16) — the f32-vs-bf16
     # step-time and activation-bytes columns PERF.md tracks
+    # step_breakdown: feed_s/compute_s/update_s per step over REAL
+    # per-step feeds, device-prefetch off vs on (the MFU story's
+    # where-did-the-time-go table)
     run_bench('mnist_conv_examples_per_sec', batch, build, feed,
               steps=500 if on_tpu() else 5,
               note='batch=%d' % batch,
               compile_stats=True,
-              amp_compare='bf16')
+              amp_compare='bf16',
+              step_breakdown=True)
 
 
 if __name__ == '__main__':

@@ -42,10 +42,13 @@ def main():
                 'label': rng.integers(0, classes, (batch, 1)).astype(
                     np.int32)}
 
+    # step_breakdown: the feed_s column (host staging on the step
+    # critical path) vs compute_s, device-prefetch off/on
     run_bench('vgg16_train_img_per_sec', batch, build, feed,
               steps=40 if on_tpu() else 3,  # K=40: +8% vs K=10 (dispatch)
               note='batch=%d hw=%d NHWC' % (batch, hw),
-              dtype='bfloat16')
+              dtype='bfloat16',
+              step_breakdown=True)
     # f32 build through the AMP pass: amp=off is the true f32 baseline,
     # amp=bf16 should match the manual-cast headline above
     run_bench('vgg16_train_img_per_sec', batch,

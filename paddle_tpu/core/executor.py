@@ -9,7 +9,10 @@ for the MXU; parameters stay device-resident in the Scope and are donated
 across steps, so a full train step (forward + backward + optimizer update)
 is one device launch with zero host round-trips.
 """
+import contextlib
+import re
 import time
+import warnings
 
 import numpy as np
 
@@ -123,6 +126,26 @@ class _ExecutorMetrics(object):
             'paddle_tpu_amp_skipped_steps_total',
             'training steps skipped by dynamic loss scaling '
             '(non-finite gradients; f16 mode only)').child()
+        self.donated_feed_bytes = r.counter(
+            'paddle_tpu_executor_donated_feed_bytes_total',
+            'bytes of executor-staged feed buffers donated into '
+            'compiled steps (XLA reuses them for the short-lived '
+            'intermediates the donation analysis reports)').child()
+        self.feed_blocking_puts = r.counter(
+            'paddle_tpu_executor_feed_blocking_puts_total',
+            'per-step feed staging operations on the run_steps '
+            'critical path (device idle while the host stacks/'
+            'transfers); with PADDLE_TPU_DEVICE_PREFETCH only the '
+            'pipeline-priming chunk counts here').child()
+        self.feed_prefetched_puts = r.counter(
+            'paddle_tpu_executor_feed_prefetched_puts_total',
+            'per-step feed chunks staged by the device-prefetch '
+            'pipeline while a previous chunk was executing '
+            '(overlapped, off the critical path)').child()
+        self.feed_prefetched_bytes = r.counter(
+            'paddle_tpu_executor_feed_prefetched_bytes_total',
+            'bytes staged by the device-prefetch pipeline while a '
+            'previous chunk was executing').child()
 
 
 _exec_metrics = None
@@ -141,6 +164,51 @@ def _nbytes(arrays):
     return sum(getattr(v, 'nbytes', 0) for v in arrays.values())
 
 
+def _feed_aval_strs(feed_arrays):
+    """The jax donation warning names each unusable buffer as
+    ShapedArray(<dtype>[<d0>,<d1>,...]); precompute those strings for
+    the donated feed buffers so _quiet_unused_donation can tell an
+    expected feed-donation miss apart from a state-donation one."""
+    out = set()
+    for v in feed_arrays.values():
+        dt = np.dtype(v.dtype).name
+        out.add('ShapedArray(%s[%s])'
+                % (dt, ','.join(str(d) for d in v.shape)))
+    return out
+
+
+@contextlib.contextmanager
+def _quiet_unused_donation(feed_arrays=None):
+    """Silence jax's "Some donated buffers were not usable" warning for
+    one compiling invocation of a FEED-donating plan.  Donated feed
+    buffers are executor-staged host data that is dead after the step —
+    donating them is an ownership statement (and free aliasing headroom
+    where an output happens to match); a feed shape rarely matches an
+    output, so the warning is expected there and would fire on every
+    fresh compile.  The warning is swallowed ONLY when every buffer it
+    names matches a donated feed aval (best-effort: a state table that
+    shares a feed's shape+dtype is indistinguishable in the message);
+    anything else re-emits, because an unusable STATE donation is a
+    real peak-HBM regression worth hearing about.  State-donating-only
+    plans (feed_arrays falsy) are never filtered."""
+    if not feed_arrays:
+        yield
+        return
+    allowed = _feed_aval_strs(feed_arrays)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        yield
+    for w in caught:
+        msg = str(w.message)
+        if msg.startswith('Some donated buffers were not usable'):
+            named = set(re.findall(r'ShapedArray\([^)]*\)',
+                                   msg.split('\n', 1)[0]))
+            if named and named <= allowed:
+                continue
+        warnings.warn_explicit(w.message, w.category, w.filename,
+                               w.lineno)
+
+
 def _sparse_apply_mode():
     """Resolved sparse-apply lowering for a plan build (re-read every
     build, like the graph-opt level, so PADDLE_TPU_SPARSE_APPLY flips
@@ -148,6 +216,15 @@ def _sparse_apply_mode():
     trace)."""
     from ..ops.pallas.table_update import sparse_apply_mode
     return sparse_apply_mode()
+
+
+def _dense_apply_mode():
+    """Resolved dense-apply lowering for a plan build
+    (PADDLE_TPU_DENSE_APPLY; same re-read-per-build / plan-cache-key
+    contract as the sparse mode — the pallas/xla choice is baked into
+    the traced optimizer ops)."""
+    from ..ops.pallas.dense_update import dense_apply_mode
+    return dense_apply_mode()
 
 
 def _amp_plan_key():
@@ -520,17 +597,44 @@ def _np_to_device_dtype(arr, var):
     return arr
 
 
+def _convert_feed(block, feed):
+    """One feed dict → {column name: array} through _to_feed_arrays
+    (which may add companion columns like the LEN_SUFFIX lengths).
+    The single home of that expansion for run(), run_steps and the
+    chunked prefetch pre-validation — the paths must agree on the
+    column set or a feed accepted by one is rejected by another."""
+    fa = {}
+    for name, value in feed.items():
+        fa.update(_to_feed_arrays(name, value, block.vars.get(name)))
+    return fa
+
+
+def _feed_shape_error(name, shapes):
+    """The run_steps shape contract, stated once for both the one-shot
+    stack and the chunked pre-validation."""
+    return ValueError(
+        "run_steps feeds must agree in shape across steps (static "
+        "shapes — one compiled scan), but %r varies: %s.  Pad "
+        "batches to a common shape or fall back to per-step run()"
+        % (name, sorted(shapes)))
+
+
+def _feed_column_error(step, got, want):
+    """The run_steps column-set contract (e.g. a LEN_SUFFIX companion
+    fed in only SOME steps), stated once for both the one-shot stack
+    and the chunked pre-validation."""
+    return ValueError(
+        "run_steps feeds must produce one column set across steps; "
+        "step %d yields %s vs %s" % (step, sorted(got), sorted(want)))
+
+
 def _stack_feed_col(name, vals):
     """Stack one feed column across K steps; the scan needs identical
     shapes per step (XLA static shapes), so say which feed broke the
     contract instead of letting np.stack fail opaquely."""
     shapes = {np.shape(v) for v in vals}
     if len(shapes) > 1:
-        raise ValueError(
-            "run_steps feeds must agree in shape across steps (static "
-            "shapes — one compiled scan), but %r varies: %s.  Pad "
-            "batches to a common shape or fall back to per-step run()"
-            % (name, sorted(shapes)))
+        raise _feed_shape_error(name, shapes)
     return np.stack(vals)
 
 
@@ -586,6 +690,10 @@ class Executor(object):
         # that plan was built with the pipeline off) — see
         # transpiler/passes.run_pipeline
         self.last_graph_opt_report = None
+        # step-time breakdown of the most recent run_steps call
+        # (feed_s / feed_overlap_s / update_s / chunks) — the numbers
+        # behind benchmarks/common.py's where-did-the-time-go table
+        self.last_run_steps_report = None
 
     # ------------------------------------------------------------------
     def run(self,
@@ -613,15 +721,21 @@ class Executor(object):
         block = program.global_block()
 
         mesh, dev = self._mesh_and_dev(program)
-        feed_arrays = {}
-        for name, value in feed.items():
-            var = block.vars.get(name)
-            feed_arrays.update(_to_feed_arrays(name, value, var))
+        feed_arrays = _convert_feed(block, feed)
+        # every buffer the executor stages itself this call (host data
+        # in, device_put here) is dead the moment the step consumes it
+        # — donate it so XLA reuses the memory for step intermediates.
+        # A caller-staged jax.Array (or any mesh re-placement, where
+        # device_put may alias the caller's buffer) stays caller-owned
+        # and must NOT be donated.
+        feed_donate = (mesh is None and bool(feed_arrays) and
+                       not any(isinstance(v, jax.Array)
+                               for v in feed_arrays.values()))
         feed_arrays = self._stage_feed(feed_arrays, mesh, dev)
 
         plan = self._get_plan(program, block, scope, feed_arrays,
                               tuple(fetch_names), use_program_cache,
-                              mesh=mesh)
+                              mesh=mesh, feed_donate=feed_donate)
         (fn, _raw, state_rw_names, state_ro_names) = plan
 
         state_rw = self._stage_state(
@@ -637,15 +751,24 @@ class Executor(object):
             em.steps.inc()
             em.feed_bytes.inc(_nbytes(feed_arrays))
             em.donated_state_bytes.inc(_nbytes(state_rw))
+            if feed_donate:
+                em.donated_feed_bytes.inc(_nbytes(feed_arrays))
 
         # the span covers dispatch + scope update + (for return_numpy)
-        # the host sync, so its histogram reads as per-call latency
-        with _obs.span('executor.run'):
-            if em is not None and self._plan_fresh:
+        # the host sync, so its histogram reads as per-call latency.
+        # The donation-warning filter only arms on the compiling
+        # invocation — the warning can only fire there, and
+        # warnings.catch_warnings mutates process-global state, which
+        # the cached steady-state dispatches must stay clear of
+        fresh = self._plan_fresh
+        self._plan_fresh = False
+        with _obs.span('executor.run'), \
+                _quiet_unused_donation(
+                    feed_arrays if (feed_donate and fresh) else None):
+            if em is not None and fresh:
                 # first invocation of a fresh plan: jit compiles
                 # synchronously inside this call.  The inner span also
                 # lands "executor.compile" on any running XLA trace
-                self._plan_fresh = False
                 with _obs.span('executor.compile'):
                     t0 = time.perf_counter()
                     fetches, new_state = fn(feed_arrays, state_rw,
@@ -772,7 +895,7 @@ class Executor(object):
         return tuple(sorted(rw)), tuple(sorted(ro)), tuple(sorted(out))
 
     def _get_plan(self, program, block, scope, feed_arrays, fetch_names,
-                  use_cache, mesh=None):
+                  use_cache, mesh=None, feed_donate=False):
         feed_sig = tuple(
             (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
             for n in sorted(feed_arrays))
@@ -784,16 +907,20 @@ class Executor(object):
         # gc and would alias a fresh scope's plans with a dead one's.
         # The graph-opt level participates too: a flag flip must not be
         # served a plan traced at the old level.  Same for the sparse-
-        # apply lowering (PADDLE_TPU_SPARSE_APPLY): the pallas/xla
-        # choice is baked into the traced optimizer ops.
+        # and dense-apply lowerings (PADDLE_TPU_SPARSE_APPLY /
+        # PADDLE_TPU_DENSE_APPLY): the pallas/xla choice is baked into
+        # the traced optimizer ops.
         # ... and the AMP mode (PADDLE_TPU_AMP): a bf16-rewritten trace
         # must never serve an f32 request or vice versa.
+        # feed_donate keys the donation variant: a plan jitted with the
+        # feed argument donated must never serve a call whose feed
+        # buffers the caller still owns.
         opt_level = _graph_opt_level(program)
         amp_key = _amp_plan_key()
         key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names,
                scope._uid, mesh, opt_level, _sparse_apply_mode(),
-               amp_key)
+               _dense_apply_mode(), amp_key, feed_donate)
         if use_cache and key in self._cache:
             self._plan_fresh = False
             # keep the report describing THIS plan, not whichever plan
@@ -911,7 +1038,14 @@ class Executor(object):
             new_state = {n: env[n] for n in state_out_names if n in env}
             return fetches, new_state
 
-        fn = jax.jit(step_fn, donate_argnums=(1,))
+        # state is always donated; the feed argument joins it when the
+        # caller (run) proved this plan only ever sees executor-staged
+        # feed buffers — the donated feeds are exactly the extra reuse
+        # headroom the PR-3 donation analysis reports (short-lived
+        # intermediates can land in the dead feed buffers instead of
+        # growing peak HBM)
+        fn = jax.jit(step_fn,
+                     donate_argnums=(0, 1) if feed_donate else (1,))
         plan = (fn, step_fn, state_rw_names, state_ro_names)
         if use_cache:
             self._cache[key] = plan
@@ -973,48 +1107,41 @@ class Executor(object):
                                 "adds %s" % extra if extra else '']))))
 
         mesh, dev = self._mesh_and_dev(program)
-        feed0 = {}
-        for name, value in feeds[0].items():
-            var = block.vars.get(name)
-            feed0.update(_to_feed_arrays(name, value, var))
-        feed0 = self._stage_feed(feed0, mesh, dev)
+        feed0 = self._stage_feed(_convert_feed(block, feeds[0]),
+                                 mesh, dev)
 
         fn_plan = self._get_plan(program, block, scope, feed0,
                                  fetch_names, True, mesh=mesh)
         _fn, raw_fn, rw_names, ro_names = fn_plan
 
-        # the graph-opt level keys the multi plan too: the scan closes
-        # over raw_fn, which traces the (un)optimized program — a flag
-        # flip must not be served a scan over the old one
-        mkey = ('multi', program._uid, program.version, k, stacked,
-                fetch_names,
-                tuple((n, feed0[n].shape, str(feed0[n].dtype))
-                      for n in sorted(feed0)), scope._uid,
-                rw_names, ro_names, mesh, _graph_opt_level(program),
-                _sparse_apply_mode(), _amp_plan_key())
-        multi = self._cache.get(mkey)
-        multi_fresh = multi is None
-        if multi_fresh:
-            if _obs.enabled():
-                _em().plan_cache_misses.inc()
-            multi = jax.jit(make_multi_step_fn(raw_fn, stacked, k),
-                            donate_argnums=(2,))
-            self._cache[mkey] = multi
-        elif _obs.enabled():
-            _em().plan_cache_hits.inc()
+        from ..flags import FLAGS
+        prefetch = bool(FLAGS.device_prefetch) and stacked
+        # per-call step-time breakdown (benchmarks/common.py reads it):
+        # feed_s = host feed staging on the critical path (device
+        # idle), feed_overlap_s = staging done while a previous chunk
+        # was executing, update_s = scope write-back.
+        report = {'k': k, 'device_prefetch': prefetch,
+                  'chunks': 1, 'chunk_steps': k,
+                  'feed_s': 0.0, 'feed_overlap_s': 0.0,
+                  'update_s': 0.0}
+        self.last_run_steps_report = report
+        em = _em() if _obs.enabled() else None
+
+        if prefetch:
+            return self._run_steps_prefetch(
+                program, block, scope, feeds, k, feed0, fetch_names,
+                rw_names, ro_names, raw_fn, mesh, dev, em, report,
+                return_numpy)
+
+        multi, multi_fresh = self._multi_plan(
+            program, scope, feed0, fetch_names, rw_names, ro_names,
+            mesh, raw_fn, k, stacked)
 
         xs = None
         if stacked:
-            cols = {}
-            for f in feeds:
-                fa = {}
-                for name, value in f.items():
-                    var = block.vars.get(name)
-                    fa.update(_to_feed_arrays(name, value, var))
-                for n, v in fa.items():
-                    cols.setdefault(n, []).append(np.asarray(v))
-            xs = {n: jax.device_put(_stack_feed_col(n, vs), dev)
-                  for n, vs in cols.items()}
+            tf = time.perf_counter()
+            xs = self._stack_chunk(feeds, 0, k, block, dev)
+            report['feed_s'] = time.perf_counter() - tf
 
         state_rw = self._stage_state(
             {n: scope.get(n) for n in rw_names}, mesh, dev)
@@ -1024,33 +1151,279 @@ class Executor(object):
             jax.random.PRNGKey(self._base_seed(program)), dev)
         t0 = jnp.asarray(self._step, jnp.int32)
 
-        em = _em() if _obs.enabled() else None
         if em is not None:
             em.steps.inc(k)
             em.feed_bytes.inc(_nbytes(feed0) + (_nbytes(xs) if xs else 0))
             em.donated_state_bytes.inc(_nbytes(state_rw))
+            if xs:
+                # the whole [K, ...] stack is staged in one put before
+                # the dispatch — the critical-path event the
+                # device-prefetch pipeline exists to hide
+                em.feed_blocking_puts.inc()
+                em.donated_feed_bytes.inc(_nbytes(xs))
 
         with _obs.span('executor.run_steps'):
-            if em is not None and multi_fresh:
-                with _obs.span('executor.compile'):
-                    tc = time.perf_counter()
-                    ys, rw_f, last_extra = multi(feed0, xs, state_rw,
-                                                 state_ro, key0, t0)
-                    em.compile_seconds.observe(time.perf_counter() - tc)
-                em.compiles.inc()
-            else:
-                ys, rw_f, last_extra = multi(feed0, xs, state_rw,
-                                             state_ro, key0, t0)
+            ys, rw_f, last_extra = self._dispatch_multi(
+                multi, multi_fresh, em, feed0, xs, state_rw, state_ro,
+                key0, t0)
             self._step += k
+            tu = time.perf_counter()
             for n, v in rw_f.items():
                 scope.set(n, v)
             for n, v in last_extra.items():
                 scope.set(n, v)
+            report['update_s'] = time.perf_counter() - tu
             if em is not None and return_numpy:
                 self._note_amp_skips(rw_f, scope)
             if return_numpy:
                 return [np.asarray(y) for y in ys]
             return list(ys)
+
+    def _multi_plan(self, program, scope, feed0, fetch_names, rw_names,
+                    ro_names, mesh, raw_fn, k, stacked):
+        """Get-or-build the jitted K-step scan plan for one scan length.
+
+        The graph-opt level (and the sparse/dense apply modes and AMP
+        key) key the multi plan too: the scan closes over raw_fn, which
+        traces the (un)optimized program — a flag flip must not be
+        served a scan over the old one.  The stacked feed argument (xs)
+        is donated along with the state: run_steps always builds the
+        stack itself from host copies, so the buffer is executor-owned
+        and dead once the scan consumed it — XLA gets the whole stack
+        back for intermediates instead of holding K dead batches."""
+        mkey = ('multi', program._uid, program.version, k, stacked,
+                fetch_names,
+                tuple((n, feed0[n].shape, str(feed0[n].dtype))
+                      for n in sorted(feed0)), scope._uid,
+                rw_names, ro_names, mesh, _graph_opt_level(program),
+                _sparse_apply_mode(), _dense_apply_mode(),
+                _amp_plan_key())
+        multi = self._cache.get(mkey)
+        fresh = multi is None
+        if fresh:
+            if _obs.enabled():
+                _em().plan_cache_misses.inc()
+            multi = jax.jit(make_multi_step_fn(raw_fn, stacked, k),
+                            donate_argnums=(1, 2) if stacked else (2,))
+            self._cache[mkey] = multi
+        elif _obs.enabled():
+            _em().plan_cache_hits.inc()
+        return multi, fresh
+
+    def _dispatch_multi(self, multi, fresh, em, feed0, xs, state_rw,
+                        state_ro, key0, t0):
+        """Invoke a multi-step plan, timing the first (compiling)
+        invocation of a fresh plan under the executor.compile span.
+        The donation-warning filter arms only on that compiling call —
+        steady-state dispatches must not touch the process-global
+        warnings state."""
+        with _quiet_unused_donation(
+                xs if (xs is not None and fresh) else None):
+            if em is not None and fresh:
+                with _obs.span('executor.compile'):
+                    tc = time.perf_counter()
+                    out = multi(feed0, xs, state_rw, state_ro, key0, t0)
+                    em.compile_seconds.observe(time.perf_counter() - tc)
+                em.compiles.inc()
+                return out
+            return multi(feed0, xs, state_rw, state_ro, key0, t0)
+
+    def _stack_chunk(self, feeds, lo, hi, block, dev):
+        """Stack feeds[lo:hi] into device-staged [hi-lo, ...] columns
+        (the one-shot path; the chunked path pre-converts and validates
+        every feed before its first dispatch instead)."""
+        cols = {}
+        want = None
+        for i, f in enumerate(feeds[lo:hi]):
+            fa = _convert_feed(block, f)
+            if want is None:
+                want = set(fa)
+            elif set(fa) != want:
+                # must fail here, not as an opaque scan-length
+                # mismatch after state staging
+                raise _feed_column_error(lo + i, set(fa), want)
+            for n, v in fa.items():
+                cols.setdefault(n, []).append(np.asarray(v))
+        return {n: jax.device_put(_stack_feed_col(n, vs), dev)
+                for n, vs in cols.items()}
+
+    def _run_steps_prefetch(self, program, block, scope, feeds, k,
+                            feed0, fetch_names, rw_names, ro_names,
+                            raw_fn, mesh, dev, em, report,
+                            return_numpy):
+        """Device-resident run_steps (PADDLE_TPU_DEVICE_PREFETCH): the
+        K-step feed stack is staged in chunks through a double-buffered
+        pipeline — the host stacks and device_puts chunk c+1 while the
+        device scans chunk c — so steady-state steps never wait on a
+        host transfer, and only ~2 chunks of feed are resident instead
+        of the whole [K, ...] stack.  Bitwise-identical to the one-shot
+        path: the scan body folds the PRNG key with the ABSOLUTE step
+        index (key0, t), so chunk boundaries don't exist numerically,
+        and the donated state chains from each chunk's output into the
+        next chunk's input without a host round trip."""
+        from ..flags import FLAGS
+        from ..runtime.prefetch import device_prefetch
+        cs = int(FLAGS.device_prefetch_chunk) or max(1, -(-k // 4))
+        cs = max(1, min(cs, k))
+        bounds = [(lo, min(lo + cs, k)) for lo in range(0, k, cs)]
+        report['chunks'] = len(bounds)
+        report['chunk_steps'] = cs
+        started = [False]  # has any chunk been dispatched yet?
+
+        # Convert + validate EVERY feed before the first dispatch: the
+        # one-shot path fails atomically on a shape mismatch, and the
+        # chunked path must too — chunk 0 donates the scope's state
+        # buffers, so raising mid-stream would leave the scope holding
+        # deleted arrays with half the steps applied.  Conversion is
+        # host-side and copy-free for already-conforming ndarray feeds
+        # (np.asarray is a view), but dtype coercion (int64→int32 &
+        # co) copies — it happens on the critical path, so it counts
+        # toward feed_s, not silently toward compute.  The per-chunk
+        # np.stack + device_put — the bulk copy and transfer — still
+        # runs overlapped in the thunks.
+        tv = time.perf_counter()
+        col_shapes = {}
+        col_dtypes = {}
+        conv = []
+        for f in feeds:
+            fa = _convert_feed(block, f)
+            if conv and set(fa) != set(conv[0]):
+                # e.g. one step fed (data, lengths) where another fed a
+                # plain array: the LEN_SUFFIX companion appears in only
+                # one of them
+                raise _feed_column_error(len(conv), set(fa), set(conv[0]))
+            for n in sorted(fa):
+                v = np.asarray(fa[n])
+                fa[n] = v
+                want = col_shapes.setdefault(n, v.shape)
+                if v.shape != want:
+                    raise _feed_shape_error(n, {want, v.shape})
+                # join the column dtype across ALL steps: the one-shot
+                # path's single np.stack over K steps promotes every
+                # step to the column's result_type, so each chunk must
+                # stack to that same dtype — both for bitwise parity
+                # and so every chunk shares ONE jit signature (a dtype
+                # drift would otherwise force a fresh trace mid-stream,
+                # after the scope state was donated)
+                have = col_dtypes.get(n)
+                col_dtypes[n] = (v.dtype if have is None
+                                 else np.result_type(have, v.dtype))
+            conv.append(fa)
+        report['feed_s'] += time.perf_counter() - tv
+
+        def make_thunk(lo, hi):
+            def thunk():
+                ts = time.perf_counter()
+                xs = {n: jax.device_put(
+                          np.stack([conv[i][n] for i in range(lo, hi)])
+                          .astype(col_dtypes[n], copy=False),
+                          dev)
+                      for n in col_shapes}
+                dt = time.perf_counter() - ts
+                nb = _nbytes(xs)
+                if started[0]:
+                    report['feed_overlap_s'] += dt
+                    if em is not None:
+                        em.feed_prefetched_puts.inc()
+                        em.feed_prefetched_bytes.inc(nb)
+                else:
+                    # pipeline prime: the only staging the device ever
+                    # waits for
+                    report['feed_s'] += dt
+                    if em is not None:
+                        em.feed_blocking_puts.inc()
+                if em is not None:
+                    em.feed_bytes.inc(nb)
+                    em.donated_feed_bytes.inc(nb)
+                return lo, hi, xs
+            return thunk
+
+        state_rw = self._stage_state(
+            {n: scope.get(n) for n in rw_names}, mesh, dev)
+        state_ro = self._stage_state(
+            {n: scope.get(n) for n in ro_names}, mesh, dev)
+        key0 = jax.device_put(
+            jax.random.PRNGKey(self._base_seed(program)), dev)
+        base = self._step
+        if em is not None:
+            # steps_total counts per COMPLETED chunk below, not k
+            # up-front: a mid-stream failure lands the boundary state
+            # and advances self._step by `done`, and the metric must
+            # agree with that resumable step count
+            em.feed_bytes.inc(_nbytes(feed0))
+            em.donated_state_bytes.inc(_nbytes(state_rw))
+        ys_parts = []
+        last_extra = {}
+        done = 0  # steps landed by completed chunks
+        with _obs.span('executor.run_steps'):
+            try:
+                for lo, hi, xs in device_prefetch(
+                        make_thunk(lo, hi) for lo, hi in bounds):
+                    multi, fresh = self._multi_plan(
+                        program, scope, feed0, fetch_names, rw_names,
+                        ro_names, mesh, raw_fn, hi - lo, True)
+                    ys, state_rw, last_extra = self._dispatch_multi(
+                        multi, fresh, em, feed0, xs, state_rw, state_ro,
+                        key0, jnp.asarray(base + lo, jnp.int32))
+                    started[0] = True
+                    if em is not None:
+                        em.steps.inc(hi - done)
+                    done = hi
+                    ys_parts.append(ys)
+            except BaseException as e:
+                # BaseException: a Ctrl-C during the seconds-wide
+                # multi-chunk host loop must land the boundary state
+                # too, or the scope keeps referencing donated buffers
+                if not started[0]:
+                    raise
+                # A completed chunk donated the scope's original state
+                # buffers, so "unwind to before the call" no longer
+                # exists.  On a mid-stream compile/staging failure
+                # (feed errors never get here — every feed validated
+                # above) the last completed chunk's OUTPUT state is
+                # alive: land it and advance the step counter so the
+                # scope reads as exactly "first `done` steps applied"
+                # (a consistent, resumable boundary) instead of
+                # holding references to deleted arrays.  But if the
+                # failing chunk's EXECUTION already consumed that
+                # carry before raising (e.g. a debug-nans abort fires
+                # after donation), there is nothing consistent to land
+                # — surface the original error unwrapped rather than
+                # publish deleted arrays under a resumability claim.
+                if any(getattr(v, 'is_deleted', lambda: False)()
+                       for v in state_rw.values()):
+                    raise
+                for n, v in state_rw.items():
+                    scope.set(n, v)
+                for n, v in last_extra.items():
+                    scope.set(n, v)
+                self._step += done
+                if not isinstance(e, Exception):
+                    raise  # KeyboardInterrupt & co propagate as-is
+                raise RuntimeError(
+                    "run_steps(device_prefetch) failed mid-stream "
+                    "after %d of %d steps; the scope holds the state "
+                    "of the %d completed steps" % (done, k, done)) \
+                    from e
+            self._step += k
+            tu = time.perf_counter()
+            for n, v in state_rw.items():
+                scope.set(n, v)
+            for n, v in last_extra.items():
+                scope.set(n, v)
+            report['update_s'] = time.perf_counter() - tu
+            if em is not None and return_numpy:
+                self._note_amp_skips(state_rw, scope)
+            outs = []
+            for i in range(len(fetch_names)):
+                parts = [p[i] for p in ys_parts]
+                if return_numpy:
+                    outs.append(np.concatenate(
+                        [np.asarray(x) for x in parts]))
+                else:
+                    outs.append(parts[0] if len(parts) == 1
+                                else jnp.concatenate(parts))
+            return outs
 
     def _compile_common(self, program, feed, fetch_list, scope):
         if program is None:
@@ -1098,8 +1471,12 @@ class Executor(object):
         """Drop every cached plan and re-read late-bound flags: the
         persistent-compile-cache dir (PADDLE_TPU_COMPILATION_CACHE_DIR)
         is re-applied, and the next plan build re-reads
-        PADDLE_TPU_GRAPH_OPT_LEVEL (the level is part of every plan key,
-        so flips invalidate naturally — this just frees the old plans)."""
+        PADDLE_TPU_GRAPH_OPT_LEVEL, PADDLE_TPU_SPARSE_APPLY,
+        PADDLE_TPU_DENSE_APPLY, and PADDLE_TPU_AMP (each is part of
+        every plan key, so flips invalidate naturally — this just frees
+        the old plans).  PADDLE_TPU_DEVICE_PREFETCH is re-read on every
+        run_steps call and its chunking keys the scan plans by length,
+        so it needs no special handling here either."""
         self.close()
         _maybe_enable_compilation_cache()
 

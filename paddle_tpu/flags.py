@@ -115,6 +115,38 @@ DEFINE_string('sparse_apply', 'auto',
               '"auto" (default) picks pallas on TPU and xla elsewhere. '
               'Resolved per trace and part of the executor plan cache '
               'key, so flips take effect on the next plan build')
+DEFINE_string('dense_apply', 'auto',
+              'lowering for the dense optimizer apply (sgd/momentum/'
+              'adam dense branches): "pallas" runs the fused one-pass '
+              'flat-walk kernels (ops/pallas/dense_update.py — param + '
+              'every moment read once, written once in place; '
+              'interpret mode off-TPU), "xla" keeps the jnp expression '
+              'chains (several fusions with intermediate HBM '
+              'round-trips per parameter), "auto" (default) picks '
+              'pallas on TPU and xla elsewhere.  Resolved per trace '
+              'and part of the executor plan cache key, so flips '
+              '(including after Executor.reset_cache()) take effect '
+              'on the next plan build.  Both lowerings are '
+              'bitwise-identical (tests/test_pallas_dense_update.py)')
+DEFINE_bool('device_prefetch', False,
+            'device-resident double-buffered feed for '
+            'Executor.run_steps with per-step feeds: the K-step feed '
+            'stack is staged in chunks, and the host device_puts '
+            'chunk c+1 while the device scans chunk c, so steady-state '
+            'steps see zero blocking host transfers (counters '
+            'paddle_tpu_executor_feed_blocking_puts_total / '
+            '_feed_prefetched_bytes_total prove it) and only ~2 chunks '
+            'of feed are resident in HBM instead of the whole [K, ...] '
+            'stack.  Off (default) stages the full stack in one '
+            'blocking put before dispatch.  Re-read on every '
+            'run_steps call (and after Executor.reset_cache()); '
+            'numerics are bitwise-identical either way')
+DEFINE_int('device_prefetch_chunk', 0,
+           'steps per staged chunk when PADDLE_TPU_DEVICE_PREFETCH is '
+           'on; 0 (default) auto-sizes to ~K/4 (min 1) so the pipeline '
+           'keeps one chunk in flight while one computes.  Each chunk '
+           'size compiles its own scan plan (cached like every other '
+           'plan)')
 DEFINE_string('amp', '0',
               'automatic mixed-precision training pass '
               '(transpiler/amp.py), applied per plan build after the '

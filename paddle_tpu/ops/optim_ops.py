@@ -27,6 +27,21 @@ per trace by ops/pallas/table_update.sparse_apply_mode():
 PADDLE_TPU_SPARSE_APPLY=xla|pallas pins the path (default: pallas on
 TPU, xla elsewhere); the resolved mode is part of the executor's plan
 cache key, so a flip retraces.
+
+The DENSE applies of sgd/momentum/adam have the same two lowerings,
+selected by ops/pallas/dense_update.dense_apply_mode()
+(PADDLE_TPU_DENSE_APPLY, same default/cache-key contract):
+
+  'xla'    — the jnp expression chains below, verbatim: several fused
+             multiply-adds whose intermediates round-trip HBM between
+             fusions (dense Adam reads/writes each state table more
+             than once per step).
+  'pallas' — ops/pallas/dense_update.py: ONE grid walk over the
+             flattened param applies the whole rule — each state table
+             is read once and written once through
+             input_output_aliases.  Bitwise-identical to the XLA path
+             (tier-1 tests/test_pallas_dense_update.py), AMP f32-master
+             grads included.
 """
 import jax.numpy as jnp
 
@@ -46,6 +61,19 @@ def _pallas_rowwise(p, values):
         return False
     from .pallas.table_update import sparse_apply_mode
     return sparse_apply_mode() == 'pallas'
+
+
+def _pallas_dense(p, g):
+    """True when the fused flat-walk kernel should serve this dense
+    update: mode resolves to pallas and grad/param agree in shape (the
+    kernels flatten, so any rank qualifies; a broadcasting or empty
+    operand falls back to the jnp chain)."""
+    if getattr(p, 'shape', None) != getattr(g, 'shape', None):
+        return False
+    if getattr(p, 'size', 0) == 0:
+        return False
+    from .pallas.dense_update import dense_apply_mode
+    return dense_apply_mode() == 'pallas'
 
 
 def _p32(x):
@@ -90,7 +118,21 @@ def _sgd(ctx, ins, attrs):
             return {'ParamOut': [p_new.astype(p.dtype)]}
         p_new = _p32(p).at[rows].add(-lr * _p32(values))
         return {'ParamOut': [p_new.astype(p.dtype)]}
-    return {'ParamOut': [(_p32(p) - lr * _p32(grad)).astype(p.dtype)]}
+    g = _p32(grad)
+    # optional fused L2 weight decay (the scale+sum pair
+    # append_regularization_ops would otherwise weave as separate ops)
+    wd = attrs.get('weight_decay', 0.0)
+    if _pallas_dense(p, g):
+        from .pallas.dense_update import dense_apply_sgd
+        p_new = dense_apply_sgd(
+            _p32(p), g, lr,
+            weight_decay=jnp.float32(wd) if wd else None)
+        return {'ParamOut': [p_new.astype(p.dtype)]}
+    if wd:
+        return {'ParamOut': [
+            (_p32(p) - lr * (g + jnp.float32(wd) * _p32(p))).astype(
+                p.dtype)]}
+    return {'ParamOut': [(_p32(p) - lr * g).astype(p.dtype)]}
 
 
 @register_op('momentum')
@@ -100,6 +142,13 @@ def _momentum(ctx, ins, attrs):
     v = _p32(first(ins, 'Velocity'))
     lr = _p32(first(ins, 'LearningRate')).reshape(())
     mu = attrs.get('mu', 0.9)
+    if _pallas_dense(p, g):
+        from .pallas.dense_update import dense_apply_momentum
+        p_new, v_new = dense_apply_momentum(
+            _p32(p), v, g, lr, mu,
+            use_nesterov=attrs.get('use_nesterov', False))
+        return {'ParamOut': [p_new.astype(p.dtype)],
+                'VelocityOut': [v_new]}
     v_new = mu * v + g
     if attrs.get('use_nesterov', False):
         p_new = _p32(p) - (g + mu * v_new) * lr
@@ -143,6 +192,12 @@ def _adam(ctx, ins, attrs):
         return {'ParamOut': [p_new.astype(p.dtype)], 'Moment1Out': [m_new],
                 'Moment2Out': [v_new]}
     g = _p32(grad)
+    if _pallas_dense(p, g):
+        from .pallas.dense_update import dense_apply_adam
+        p_new, m_new, v_new = dense_apply_adam(
+            _p32(p), m, v, g, lr_t, b1, b2, eps)
+        return {'ParamOut': [p_new.astype(p.dtype)],
+                'Moment1Out': [m_new], 'Moment2Out': [v_new]}
     m_new = b1 * m + (1 - b1) * g
     v_new = b2 * v + (1 - b2) * jnp.square(g)
     p_new = _p32(p) - lr_t * m_new / (jnp.sqrt(v_new) + eps)

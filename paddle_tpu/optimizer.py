@@ -147,11 +147,19 @@ class Optimizer(object):
         # minimize() pass on the same program).
         with loss.block.program.op_role_guard('backward'):
             params_grads = append_gradient_clip_ops(params_grads)
-            params_grads = append_regularization_ops(params_grads,
-                                                     self.regularization)
+            params_grads = self._apply_regularization(params_grads)
         optimize_ops = self.create_optimization_pass(
             params_grads, loss, startup_program)
         return optimize_ops, params_grads
+
+    def _apply_regularization(self, params_grads):
+        """Weave the per-param/global regularizers into the grad
+        stream.  The one seam optimizers override when they can fold a
+        regularizer into their apply op instead (SGD's fused L2 weight
+        decay) — overriding here keeps a single copy of the minimize()
+        pipeline."""
+        return append_regularization_ops(params_grads,
+                                         self.regularization)
 
     def _append_optimize_op(self, block, param_and_grad):
         raise NotImplementedError
@@ -160,13 +168,61 @@ class Optimizer(object):
 class SGDOptimizer(Optimizer):
     type = 'sgd'
 
+    def _apply_regularization(self, params_grads):
+        """SGD folds L2 weight decay into the sgd op itself
+        (`weight_decay` attr → one fused apply pass, incl. the Pallas
+        dense kernel's fused arm) instead of weaving scale+sum ops per
+        param: p - lr*(g + wd*p) is the identical expression the weave
+        builds, minus two ops and one grad-sized buffer per parameter.
+        Only DENSE grads of f32-or-wider params fold — a SelectedRows
+        grad's row-wise apply never touches untouched rows, while decay
+        must shrink the whole table, so sparse params keep the weave;
+        a low-precision (bf16/f16) param keeps the weave because its
+        scale+sum intermediates round in param dtype, and the fused
+        f32 expression would silently change those numerics.  L1 (sign
+        chain) and per-param non-L2 regularizers keep the weave too."""
+        from .core import datatypes
+        from .regularizer import L2DecayRegularizer
+        self._fused_decay = {}
+        gblock = next((g.block for _, g in params_grads
+                       if g is not None), None)
+        sparse_grads = set()
+        if gblock is not None:
+            for op in gblock.ops:
+                if op.type == 'sparse_grad_assemble':
+                    sparse_grads.update(op.output_arg_names)
+        weave = []
+        for p, g in params_grads:
+            reg = getattr(p, 'regularizer', None)
+            if reg is None:
+                reg = self.regularization
+            if (g is not None and
+                    isinstance(reg, L2DecayRegularizer) and
+                    reg._regularization_coeff and
+                    g.name not in sparse_grads and
+                    not datatypes.is_low_precision(p.dtype)):
+                self._fused_decay[p.name] = float(
+                    reg._regularization_coeff)
+            else:
+                weave.append((p, g))
+        woven = iter(append_regularization_ops(weave,
+                                               self.regularization))
+        return [(p, g) if p.name in self._fused_decay else next(woven)
+                for p, g in params_grads]
+
     def _append_optimize_op(self, block, param_and_grad):
+        attrs = {}
+        wd = getattr(self, '_fused_decay', {}).get(
+            param_and_grad[0].name)
+        if wd:
+            attrs['weight_decay'] = wd
         return self.helper.append_op(
             type='sgd',
             inputs={'Param': [param_and_grad[0]],
                     'Grad': [param_and_grad[1]],
                     'LearningRate': [self._create_param_lr(param_and_grad)]},
             outputs={'ParamOut': [param_and_grad[0]]},
+            attrs=attrs,
             infer_shape=False)
 
 

@@ -5,15 +5,52 @@ Reference parity: python/paddle/v2/reader/decorator.py:318 xmap_readers
 rides.  Producers serialize samples (pickle) into the C++ ring buffer;
 blocking queue ops run without the GIL, so decode/augment work overlaps
 the train step — this is what feeds the MXU at rate.
+
+`device_prefetch` is the device-side sibling: a double-buffered staging
+pipeline for Executor.run_steps (PADDLE_TPU_DEVICE_PREFETCH) — the host
+stacks + device_puts feed chunk c+1 while the device scans chunk c, so
+the host never sits inside the step wall-clock.
 """
 import pickle
 import threading
 
 from .native import NativeQueue
 
-__all__ = ['prefetch_reader', 'xmap_native']
+__all__ = ['prefetch_reader', 'xmap_native', 'device_prefetch']
 
 _END = b'\x00__PTQ_END__'
+
+
+def device_prefetch(thunks):
+    """Double-buffered staging driver: run zero-arg staging thunks one
+    chunk AHEAD of the consumer.
+
+    Exactly one thunk is primed before the first yield (the only
+    staging the device ever waits for); every later thunk runs right
+    after the previous chunk was yielded — i.e. after the consumer
+    dispatched it.  No background thread is involved, and none is
+    needed: jax dispatch returns before the device finishes, so
+    staging-after-dispatch already runs concurrent with device
+    execution — the generator exists to pin that ordering (prime one,
+    then stage strictly after each dispatch) and to bound the live
+    staged chunks to two (the one in flight + the one just prepared),
+    which also bounds the feed's HBM footprint to ~2 chunks instead of
+    the whole run's stack.
+    """
+    it = iter(thunks)
+    try:
+        ahead = next(it)()
+    except StopIteration:
+        return
+    while True:
+        cur, ahead = ahead, None
+        yield cur
+        # the consumer just dispatched `cur`; stage the next chunk
+        # while the device chews on it
+        try:
+            ahead = next(it)()
+        except StopIteration:
+            return
 
 
 def prefetch_reader(reader, buf_size=64):
@@ -64,8 +101,22 @@ def xmap_native(mapper, reader, process_num=4, buffer_size=64,
         def feed():
             try:
                 for i, sample in enumerate(reader()):
-                    in_q.push(pickle.dumps((i, sample)))
+                    if not in_q.push(pickle.dumps((i, sample))):
+                        return  # consumer closed early
+            except BaseException as e:
+                # a reader failure must reach the consumer, not
+                # masquerade as a clean (truncated) end-of-stream —
+                # and not depend on every worker finishing either: a
+                # sibling stuck inside its mapper never pops its _END,
+                # so the n_done countdown would never close the
+                # stream.  Same ring-close as the worker path: record
+                # the error, then close both queues (the consumer's
+                # None pop observes `errors`)
+                errors.append(e)
+                in_q.close()
+                out_q.close()
             finally:
+                # no-ops after an error close
                 for _ in range(process_num):
                     in_q.push(_END)
 
@@ -76,12 +127,23 @@ def xmap_native(mapper, reader, process_num=4, buffer_size=64,
                     if blob is None or blob == _END:
                         break
                     i, sample = pickle.loads(blob)
-                    out_q.push(pickle.dumps((i, mapper(sample))))
+                    if not out_q.push(pickle.dumps((i, mapper(sample)))):
+                        break  # consumer closed early
             except BaseException as e:  # surface to the consumer
+                # mirror the FeedPipeline ring-close fix: record the
+                # error, then CLOSE both queues instead of waiting for
+                # siblings — a sibling blocked in a stuck mapper (or a
+                # feeder blocked on a full in_q) would otherwise keep
+                # the consumer waiting forever for an _END that never
+                # comes.  `errors` is appended before the closes, so
+                # the consumer's None pop observes it.
                 errors.append(e)
+                in_q.close()
+                out_q.close()
             finally:
-                # always count down so the consumer never hangs on a
-                # crashed worker; the stored error re-raises at the end
+                # clean path: count down so the LAST finisher ends the
+                # stream (a crashed worker already closed out_q; its
+                # countdown push lands on a closed queue, a no-op)
                 with done_lock:
                     n_done[0] += 1
                     if n_done[0] == process_num:
@@ -108,11 +170,13 @@ def xmap_native(mapper, reader, process_num=4, buffer_size=64,
                 while next_idx in pending:
                     yield pending.pop(next_idx)
                     next_idx += 1
+            if errors:
+                # fail BEFORE draining stragglers: a partial ordered
+                # tail after a known failure is corrupt, not data
+                raise errors[0]
             if order:  # drain any stragglers in order
                 for i in sorted(pending):
                     yield pending[i]
-            if errors:
-                raise errors[0]
         finally:
             in_q.close()
             out_q.close()

@@ -1,0 +1,375 @@
+"""Device-resident run_steps feed (PADDLE_TPU_DEVICE_PREFETCH).
+
+The chunked double-buffered pipeline must be invisible numerically —
+bitwise-identical fetches AND persistable state vs the one-shot stack,
+remainder chunks included (the scan body folds the PRNG with the
+ABSOLUTE step index, so chunk boundaries don't exist numerically) — and
+visible operationally: steady-state runs perform ZERO blocking host
+transfers per step beyond the single pipeline-priming put, proven via
+the observability feed counters (the `-m slow` regression at the
+bottom).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+
+
+def _counter_value(snap, name):
+    m = snap.get(name)
+    if not m:
+        return 0
+    return sum(s.get('value', 0) for s in m['samples'])
+
+
+def _build(scope):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 42
+    startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='float32')
+        h = fluid.layers.fc(
+            input=x, size=5, act='tanh',
+            param_attr=fluid.ParamAttr(
+                name='w1',
+                initializer=fluid.initializer.NormalInitializer(seed=3)))
+        # dropout exercises the per-step PRNG chain across chunk
+        # boundaries — the part most likely to break under chunking
+        h = fluid.layers.dropout(x=h, dropout_prob=0.3)
+        pred = fluid.layers.fc(
+            input=h, size=1,
+            param_attr=fluid.ParamAttr(
+                name='w2',
+                initializer=fluid.initializer.NormalInitializer(seed=9)))
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=label))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(k, batch=4):
+    r = np.random.RandomState(7)
+    return [{'x': r.randn(batch, 6).astype('float32'),
+             'label': r.randn(batch, 1).astype('float32')}
+            for _ in range(k)]
+
+
+def _run(k, monkeypatch, prefetch, chunk=None, calls=1):
+    from paddle_tpu.core.program import reset_unique_name_guard
+    if prefetch:
+        monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    else:
+        monkeypatch.delenv('PADDLE_TPU_DEVICE_PREFETCH', raising=False)
+    if chunk is not None:
+        monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK',
+                           str(chunk))
+    else:
+        monkeypatch.delenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK',
+                           raising=False)
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = []
+            for _ in range(calls):
+                out = exe.run_steps(main, feed=_feeds(k),
+                                    fetch_list=[loss])
+                losses.append(np.asarray(out[0]))
+            state = {v.name: np.asarray(scope.find_var(v.name)).copy()
+                     for v in main.list_vars()
+                     if v.persistable and
+                     scope.find_var(v.name) is not None}
+            return np.concatenate(losses), state, exe
+
+
+@pytest.mark.parametrize('k,chunk', [(5, 2), (6, 3), (4, None)])
+def test_prefetch_bitwise_parity(k, chunk, monkeypatch):
+    l_off, s_off, _ = _run(k, monkeypatch, prefetch=False)
+    l_on, s_on, exe = _run(k, monkeypatch, prefetch=True, chunk=chunk)
+    np.testing.assert_array_equal(l_off, l_on)
+    assert set(s_off) == set(s_on)
+    for n in sorted(s_off):
+        eq = s_off[n] == s_on[n]
+        assert eq.all(), '%s: %d/%d differ' % (n, (~eq).sum(), eq.size)
+    rep = exe.last_run_steps_report
+    assert rep['device_prefetch'] is True
+    want_chunks = -(-k // chunk) if chunk else min(4, k)
+    assert rep['chunks'] == want_chunks
+
+
+def test_prefetch_across_calls_continues_stream(monkeypatch):
+    """Two chunked run_steps calls == two unchunked calls step-for-step
+    (the PRNG/global-step chain survives both the call and the chunk
+    boundaries).  Both sides see the same feed stream (_feeds reseeds
+    per call), so the SECOND call's losses and the final state pin the
+    call-boundary continuity — a prefetch path that reset the step
+    counter or PRNG chain between calls would diverge there while the
+    first call still matched."""
+    l_two, s_two, _ = _run(4, monkeypatch, prefetch=True, chunk=2,
+                           calls=2)
+    l_one, s_one, _ = _run(4, monkeypatch, prefetch=False, calls=2)
+    np.testing.assert_array_equal(l_two, l_one)
+    assert set(s_two) == set(s_one)
+    for n in sorted(s_one):
+        eq = s_two[n] == s_one[n]
+        assert eq.all(), '%s: %d/%d differ' % (n, (~eq).sum(), eq.size)
+
+
+def test_prefetch_report_and_repeat_mode(monkeypatch):
+    """repeat-mode run_steps (single staged batch) has no per-step feed
+    to prefetch: the flag must leave it on the one-shot path."""
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    from paddle_tpu.core.program import reset_unique_name_guard
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            out = exe.run_steps(main, feed=_feeds(1)[0], fetch_list=[loss],
+                                repeat=3)
+            assert np.asarray(out[0]).shape[0] == 3
+            rep = exe.last_run_steps_report
+            assert rep['device_prefetch'] is False
+            assert rep['chunks'] == 1
+
+
+def test_mid_stream_failure_lands_chunk_boundary(monkeypatch):
+    """A failure after the first chunk donated the scope's state must
+    leave the scope at a consistent chunk boundary — "first `done`
+    steps applied" — and training must be resumable from there: the
+    interrupted-then-resumed run matches an uninterrupted one bitwise
+    (the resumed call folds the PRNG with the advanced global step)."""
+    from paddle_tpu.core.executor import Executor
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK', '2')
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    real = Executor._dispatch_multi
+    state = {'calls': 0, 'boom': False}
+
+    def flaky(self, *a, **kw):
+        state['calls'] += 1
+        if state['boom'] and state['calls'] == 2:
+            raise RuntimeError('injected chunk-1 failure')
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(Executor, '_dispatch_multi', flaky)
+    feeds = _feeds(4)
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            # warm both chunk plans so the injected failure is the only
+            # difference between the two runs
+            exe.run_steps(main, feed=feeds, fetch_list=[loss])
+            l_clean = np.asarray(
+                exe.run_steps(main, feed=feeds, fetch_list=[loss])[0])
+            s_clean = {v.name: np.asarray(scope.find_var(v.name)).copy()
+                       for v in main.list_vars()
+                       if v.persistable and
+                       scope.find_var(v.name) is not None}
+
+    state['calls'] = 0
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run_steps(main, feed=feeds, fetch_list=[loss])
+            state['calls'] = 0
+            state['boom'] = True
+            with pytest.raises(RuntimeError,
+                               match=r'after 2 of 4 steps'):
+                exe.run_steps(main, feed=feeds, fetch_list=[loss])
+            state['boom'] = False
+            # resume from the landed boundary: the remaining 2 steps
+            l_rest = np.asarray(
+                exe.run_steps(main, feed=feeds[2:], fetch_list=[loss])[0])
+            s_resumed = {v.name: np.asarray(scope.find_var(v.name)).copy()
+                         for v in main.list_vars()
+                         if v.persistable and
+                         scope.find_var(v.name) is not None}
+    np.testing.assert_array_equal(l_clean[2:], l_rest)
+    assert set(s_clean) == set(s_resumed)
+    for n in sorted(s_clean):
+        eq = s_clean[n] == s_resumed[n]
+        assert eq.all(), '%s: %d/%d differ' % (n, (~eq).sum(), eq.size)
+
+
+def test_mid_stream_execution_failure_surfaces_original_error(
+        monkeypatch):
+    """If the failing chunk's EXECUTION already consumed the donated
+    carry (a debug-nans-style abort after donation), there is no
+    consistent state to land: the original error must surface
+    unwrapped instead of the resumable-boundary RuntimeError making a
+    consistency claim the scope can't honor."""
+    from paddle_tpu.core.executor import Executor
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK', '2')
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    real = Executor._dispatch_multi
+    state = {'calls': 0}
+
+    class Boom(Exception):
+        pass
+
+    def flaky(self, multi, fresh, em, feed0, xs, state_rw, *a, **kw):
+        state['calls'] += 1
+        if state['calls'] == 2:
+            # simulate execution consuming the donated carry before
+            # the failure propagates
+            for v in state_rw.values():
+                if hasattr(v, 'delete'):
+                    v.delete()
+            raise Boom('injected execution failure')
+        return real(self, multi, fresh, em, feed0, xs, state_rw,
+                    *a, **kw)
+
+    monkeypatch.setattr(Executor, '_dispatch_multi', flaky)
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            with pytest.raises(Boom):
+                exe.run_steps(main, feed=_feeds(4), fetch_list=[loss])
+
+
+def test_mixed_dtype_feed_matches_one_shot(monkeypatch):
+    """A feed column whose per-step dtypes differ (declared-int vars
+    are fed as-is, so int8 steps can mix with int32 steps) must behave
+    exactly like the one-shot path, whose single np.stack over all K
+    steps promotes the whole column to its result_type — per-chunk
+    stacking must join to the same dtype instead of giving each chunk
+    its own jit signature."""
+    from paddle_tpu.core.program import reset_unique_name_guard
+
+    def run(prefetch):
+        if prefetch:
+            monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+            monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK', '2')
+        else:
+            monkeypatch.delenv('PADDLE_TPU_DEVICE_PREFETCH',
+                               raising=False)
+        with reset_unique_name_guard():
+            scope = fluid.core.scope.Scope()
+            with fluid.scope_guard(scope):
+                main = fluid.Program()
+                startup = fluid.Program()
+                main.random_seed = 42
+                startup.random_seed = 42
+                with fluid.program_guard(main, startup):
+                    xi = fluid.layers.data(name='xi', shape=[6],
+                                           dtype='int32')
+                    xf = fluid.layers.cast(x=xi, dtype='float32')
+                    label = fluid.layers.data(name='label', shape=[1],
+                                              dtype='float32')
+                    pred = fluid.layers.fc(
+                        input=xf, size=1,
+                        param_attr=fluid.ParamAttr(
+                            name='w',
+                            initializer=fluid.initializer
+                            .NormalInitializer(seed=3)))
+                    loss = fluid.layers.mean(
+                        x=fluid.layers.square_error_cost(input=pred,
+                                                         label=label))
+                    fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+                r = np.random.RandomState(7)
+                feeds = []
+                for i in range(4):
+                    dt = np.int8 if i < 2 else np.int32
+                    feeds.append(
+                        {'xi': r.randint(-5, 5, (4, 6)).astype(dt),
+                         'label': r.randn(4, 1).astype('float32')})
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                out = exe.run_steps(main, feed=feeds,
+                                    fetch_list=[loss])
+                w = np.asarray(scope.find_var('w')).copy()
+                return np.asarray(out[0]), w
+
+    l_off, w_off = run(False)
+    l_on, w_on = run(True)
+    np.testing.assert_array_equal(l_off, l_on)
+    np.testing.assert_array_equal(w_off, w_on)
+
+
+def test_chunk_shape_mismatch_raises(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK', '2')
+    from paddle_tpu.core.program import reset_unique_name_guard
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feeds = _feeds(4)
+            feeds[3] = {'x': np.zeros((9, 6), np.float32),
+                        'label': np.zeros((9, 1), np.float32)}
+            with pytest.raises(ValueError, match='agree in shape'):
+                exe.run_steps(main, feed=feeds, fetch_list=[loss])
+
+
+@pytest.mark.slow
+def test_steady_state_zero_blocking_transfers(monkeypatch):
+    """The acceptance regression: with device prefetch on, a
+    steady-state run_steps call performs exactly ONE blocking feed
+    staging event (the pipeline prime) no matter how many steps it
+    runs — every other chunk stages while the device is executing.
+    Asserted via the observability feed counters, not wall clock."""
+    if not obs.enabled():
+        pytest.skip('metrics disabled')
+    k, chunk = 8, 2
+    # warm: compiles both chunk plans
+    _, _, _ = _run(k, monkeypatch, prefetch=True, chunk=chunk)
+
+    from paddle_tpu.core.program import reset_unique_name_guard
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK', str(chunk))
+    with reset_unique_name_guard():
+        scope = fluid.core.scope.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build(scope)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feeds = _feeds(k)
+            exe.run_steps(main, feed=feeds, fetch_list=[loss])  # compile
+            s0 = obs.snapshot()
+            exe.run_steps(main, feed=feeds, fetch_list=[loss])  # steady
+            s1 = obs.snapshot()
+    blocking = (_counter_value(
+        s1, 'paddle_tpu_executor_feed_blocking_puts_total') -
+        _counter_value(
+            s0, 'paddle_tpu_executor_feed_blocking_puts_total'))
+    prefetched = (_counter_value(
+        s1, 'paddle_tpu_executor_feed_prefetched_puts_total') -
+        _counter_value(
+            s0, 'paddle_tpu_executor_feed_prefetched_puts_total'))
+    pre_bytes = (_counter_value(
+        s1, 'paddle_tpu_executor_feed_prefetched_bytes_total') -
+        _counter_value(
+            s0, 'paddle_tpu_executor_feed_prefetched_bytes_total'))
+    n_chunks = k // chunk
+    assert blocking == 1, 'expected only the pipeline prime, got %d' \
+        % blocking
+    assert prefetched == n_chunks - 1
+    assert pre_bytes > 0
+    # zero blocking transfers per STEP: the single prime amortizes over
+    # the whole call, every per-step transfer was overlapped
+    assert blocking / float(k) < 1.0 / chunk
+    rep = exe.last_run_steps_report
+    assert rep['feed_overlap_s'] >= 0.0
+    assert rep['chunks'] == n_chunks

@@ -248,3 +248,102 @@ def test_feed_pipeline_depth_limit_clear_error():
         FeedPipeline({'x': ((2,), np.float32)}, fill, depth=300)
     with pytest.raises(ValueError, match='2\\*workers'):
         FeedPipeline({'x': ((2,), np.float32)}, fill, workers=129)
+
+
+def test_xmap_native_stalled_sibling_does_not_swallow_error():
+    """PR-4's FeedPipeline ring-close fix, mirrored onto xmap_native:
+    one worker's mapper exception must surface in the consumer even
+    while a SIBLING worker is stalled inside its mapper — the old
+    shutdown pushed the end-sentinel only after EVERY worker counted
+    down, so the consumer hung forever waiting on the stalled one."""
+    import threading
+
+    from paddle_tpu.runtime.prefetch import xmap_native
+
+    release = threading.Event()
+
+    def stall_or_boom(x):
+        if x == 0:
+            release.wait(15)  # stalled sibling (released at teardown)
+            return x
+        raise RuntimeError('mapper exploded')
+
+    def source():
+        for i in range(8):
+            yield i
+
+    result = {}
+
+    def consume():
+        try:
+            list(xmap_native(stall_or_boom, source, process_num=2,
+                             buffer_size=2)())
+            result['end'] = 'clean'
+        except RuntimeError:
+            result['end'] = 'raised'
+
+    th = threading.Thread(target=consume, daemon=True)
+    th.start()
+    th.join(5)  # must not need the stalled worker to finish
+    alive = th.is_alive()
+    release.set()
+    assert not alive, 'consumer hung on the stalled sibling'
+    assert result.get('end') == 'raised', result
+
+
+def test_xmap_native_reader_error_with_stalled_worker():
+    """The feeder-error ring-close: a READER exception must surface in
+    the consumer even while a worker is stalled inside its mapper —
+    _END-per-worker alone relies on the n_done countdown, which the
+    stalled worker never reaches."""
+    import threading
+
+    from paddle_tpu.runtime.prefetch import xmap_native
+
+    release = threading.Event()
+
+    def stall_first(x):
+        if x == 0:
+            release.wait(15)  # stalled sibling (released at teardown)
+        return x
+
+    def bad_reader():
+        yield 0
+        yield 1
+        raise RuntimeError('reader exploded')
+
+    result = {}
+
+    def consume():
+        try:
+            list(xmap_native(stall_first, bad_reader, process_num=2,
+                             buffer_size=2)())
+            result['end'] = 'clean'
+        except RuntimeError:
+            result['end'] = 'raised'
+
+    th = threading.Thread(target=consume, daemon=True)
+    th.start()
+    th.join(5)  # must not need the stalled worker to finish
+    alive = th.is_alive()
+    release.set()
+    assert not alive, 'consumer hung on the stalled worker'
+    assert result.get('end') == 'raised', result
+
+
+def test_xmap_native_reader_error_propagates():
+    """A READER exception inside the feeder thread must reach the
+    consumer instead of masquerading as a clean, silently-truncated
+    end-of-stream (the worker-side fix alone never saw it: the feeder
+    had no except at all)."""
+    from paddle_tpu.runtime.prefetch import xmap_native
+
+    def bad_reader():
+        yield 1
+        yield 2
+        raise RuntimeError('reader exploded')
+
+    for order in (False, True):
+        with pytest.raises(RuntimeError, match='reader exploded'):
+            list(xmap_native(lambda x: x, bad_reader, process_num=2,
+                             buffer_size=4, order=order)())
