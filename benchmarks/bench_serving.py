@@ -188,6 +188,7 @@ def main():
         results.append(r)
     results.extend(dynamic_scenario(tpu))
     results.extend(amp_scenario(tpu))
+    results.extend(fleet_scenario(tpu))
     # attach the observability snapshot so BENCH_*.json runs carry the
     # queue/occupancy/latency telemetry behind the headline numbers
     # (empty when PADDLE_TPU_METRICS_ENABLED=0 — servers then report to
@@ -200,7 +201,7 @@ def main():
     return results
 
 
-def _build_ctr_tower(n_sparse):
+def _build_ctr_tower(n_sparse, seed=17):
     """A CTR-style tower (sparse id embeddings + dense stats -> small
     MLP): per-request compute is tiny, so serving cost is dominated by
     per-call dispatch of the many-field feed — exactly what dynamic
@@ -208,7 +209,7 @@ def _build_ctr_tower(n_sparse):
     import paddle_tpu as fluid
 
     main_prog, startup = fluid.Program(), fluid.Program()
-    main_prog.random_seed = startup.random_seed = 17
+    main_prog.random_seed = startup.random_seed = seed
     with fluid.program_guard(main_prog, startup):
         embs = []
         for i in range(n_sparse):
@@ -272,6 +273,211 @@ def amp_scenario(tpu):
              "note": "b%d export_bucketed CTR tower" % bucket}
         print(json.dumps(r))
         results.append(r)
+    return results
+
+
+def fleet_scenario(tpu):
+    """The serving-fleet rollout drill: Poisson open-loop traffic
+    against a 3-replica ServingFleet while the fleet goes through a
+    full operational sequence mid-load —
+
+      steady0 -> kill (drain-remove one replica) -> add (a cold replica
+      joins after AOT warmup) -> swap (hot-deploy a new model version,
+      old set drains) -> steady1
+
+    — reporting p50/p99 latency per phase, the p99 ratio of every phase
+    against the steady baseline, and the failed-request count (the
+    acceptance bar is ZERO: every operation either drains queued work
+    or retries dispatches on healthy replicas, so clients only ever see
+    results).
+
+    The production cold-start story is compile-cache-backed: replica
+    warmup (fleet start, add_replica, deploy) is disk reads, not XLA
+    compiles.  Pre-populate a cache for BOTH versions the way a real
+    deployment's earlier replicas already did — on the CPU smoke box
+    this matters doubly, because a from-scratch warmup would steal
+    the serving cores and the mid-action latency would measure the
+    compiler, not the fleet."""
+    cache_was = os.environ.get('PADDLE_TPU_COMPILATION_CACHE_DIR')
+    if not cache_was:
+        os.environ['PADDLE_TPU_COMPILATION_CACHE_DIR'] = \
+            tempfile.mkdtemp(prefix='fleet_xla_cache_')
+    try:
+        return _fleet_scenario_impl(tpu)
+    finally:
+        if cache_was is None:
+            os.environ.pop('PADDLE_TPU_COMPILATION_CACHE_DIR', None)
+        elif cache_was == '':
+            # an explicit empty-string opt-out must survive the run
+            os.environ['PADDLE_TPU_COMPILATION_CACHE_DIR'] = ''
+
+
+def _fleet_scenario_impl(tpu):
+    """The drill itself; fleet_scenario owns the compile-cache env."""
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import (BatchingInferenceServer,
+                                      ServingFleet, export_bucketed)
+    from paddle_tpu import io as pio
+
+    n_sparse = 26
+    max_batch = 16
+    per_phase = 320 if tpu else 240
+    replicas = 3
+    base_dir = tempfile.mkdtemp()
+
+    specs = {('C%d' % i): (1,) for i in range(n_sparse)}
+    specs['I'] = (13,)
+    place = fluid.TPUPlace(0) if tpu else fluid.CPUPlace()
+    for ver, seed in (('1', 17), ('2', 23)):
+        main_prog, startup, pred = _build_ctr_tower(n_sparse, seed=seed)
+        exe = fluid.Executor(place)
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        export_bucketed(os.path.join(base_dir, ver), specs, [pred],
+                        executor=exe, main_program=main_prog,
+                        scope=scope, max_batch=max_batch)
+        # one warmup pass per version populates the persistent cache
+        BatchingInferenceServer(
+            pio.bucket_artifacts(os.path.join(base_dir, ver))).close()
+
+    rng = np.random.default_rng(0)
+
+    def mk():
+        f = {('C%d' % i):
+             rng.integers(0, 10000, size=(1, 1)).astype('int32')
+             for i in range(n_sparse)}
+        f['I'] = rng.normal(size=(1, 13)).astype('float32')
+        return f
+
+    t0 = time.perf_counter()
+    fleet = ServingFleet(os.path.join(base_dir, '1'),
+                         replicas=replicas, max_wait_ms=10.0,
+                         linger_ms=0.3, health_interval_ms=100.0)
+    t_warm = time.perf_counter() - t0
+
+    f1 = mk()
+    for _ in range(32):
+        fleet.submit(f1)
+    fleet.predict(f1)  # drain + warm every replica's serving loop
+
+    # offered load: the fleet's sequential (latency-bound) predict rate
+    # — pressure enough that batching and routing matter, while the
+    # open loop stays stable on the smoke box
+    t0 = time.perf_counter()
+    for _ in range(30):
+        fleet.predict(f1)
+    lam = 30 / (time.perf_counter() - t0)
+
+    # each phase submits Poisson-paced requests for AT LEAST per_phase
+    # requests AND the full window of its fleet action (kill/add/swap
+    # run in a worker thread; the submission loop never pauses), so
+    # the latency sample actually covers the operation
+    phases = [
+        ('steady0', None),
+        ('kill', lambda: fleet.remove_replica()),
+        ('add', lambda: fleet.add_replica()),
+        ('swap', lambda: fleet.deploy(os.path.join(base_dir, '2'))),
+        ('steady1', None),
+    ]
+    sub_at, done_at, errors = [], [], []
+    phase_of = []
+    action_wall = {}
+    futs = []
+
+    def make_cb(i):
+        def cb(fut):
+            done_at[i] = time.perf_counter()
+            if fut.exception() is not None:
+                errors.append((i, fut.exception()))
+        return cb
+
+    def run_action(name, fn):
+        t0 = time.perf_counter()
+        fn()
+        action_wall[name] = time.perf_counter() - t0
+
+    cap_per_phase = per_phase * 30  # safety bound if an action stalls
+    for phase, action in phases:
+        th = None
+        if action is not None:
+            th = threading.Thread(target=run_action,
+                                  args=(phase, action))
+            th.start()
+        count = 0
+        while count < per_phase or (th is not None and th.is_alive()):
+            if count >= cap_per_phase:
+                break
+            time.sleep(float(rng.exponential(1.0 / lam)))
+            i = len(futs)
+            sub_at.append(time.perf_counter())
+            done_at.append(None)
+            phase_of.append(phase)
+            fut = fleet.submit(mk())
+            fut.add_done_callback(make_cb(i))
+            futs.append(fut)
+            count += 1
+        if th is not None:
+            th.join(300.0)
+    for fut in futs:
+        try:
+            fut.result(timeout=120.0)
+        except Exception:
+            pass  # already recorded via the callback
+    deadline = time.perf_counter() + 5.0
+    while any(d is None for d in done_at) and \
+            time.perf_counter() < deadline:
+        time.sleep(0.001)
+
+    results = []
+    p99_by_phase = {}
+    for phase, _action in phases:
+        lat = np.array([d - s for d, s, p in
+                        zip(done_at, sub_at, phase_of)
+                        if p == phase and d is not None]) * 1e3
+        p99_by_phase[phase] = float(np.percentile(lat, 99))
+        r = {"metric": "ctr_fleet_poisson_%s" % phase,
+             "value": round(float(np.percentile(lat, 99)), 2),
+             "unit": "ms p99",
+             "p50_latency_ms": round(float(np.percentile(lat, 50)), 2),
+             "p95_latency_ms": round(float(np.percentile(lat, 95)), 2),
+             "offered_req_s": round(lam, 1),
+             "n_requests": int(lat.size)}
+        if phase in action_wall:
+            r["action_wall_s"] = round(action_wall[phase], 2)
+        print(json.dumps(r))
+        results.append(r)
+    st = fleet.stats()
+    steady = p99_by_phase['steady0']
+    summary = {
+        "metric": "ctr_fleet_rollout_summary",
+        "value": len(errors), "unit": "failed requests",
+        "replicas": replicas, "warmup_s": round(t_warm, 1),
+        "offered_req_s": round(lam, 1),
+        "final_version": st['version'],
+        "deploys": st['deploys'],
+        "dispatch_retries": st['retries'],
+        "compiles_after_warmup": sum(
+            p['compiles_after_warmup'] for p in st['replicas']),
+        "p99_steady_ms": round(steady, 2),
+        "p99_worst_over_steady": round(
+            max(p99_by_phase.values()) / max(steady, 1e-9), 2),
+        "queue_wait_p99_ms": round(max(
+            p['server']['queue_wait_p99_ms']
+            for p in st['replicas']), 2),
+        "compute_p99_ms": round(max(
+            p['server']['compute_p99_ms']
+            for p in st['replicas']), 2),
+    }
+    if not tpu:
+        summary["note"] = (
+            "2-core CPU smoke box: the swap-phase p99 tail is the new "
+            "version's ~3s of (cache-hit) compile loads contending "
+            "with the only two serving cores; kill/add are invisible "
+            "(shared servable, zero builds).  On a TPU host the "
+            "compile threads don't contend with serving.")
+    print(json.dumps(summary))
+    results.append(summary)
+    fleet.close()
     return results
 
 

@@ -33,6 +33,12 @@ class _Flags(object):
     def declared(self):
         return {n: getattr(self, n) for n in self._defs}
 
+    def definitions(self):
+        """{name: (default, help_str)} for every declared flag — the
+        introspection surface tools/check_flags_doc.py audits against
+        README.md (every flag must be documented in both places)."""
+        return {n: (d, h) for n, (d, _, h) in self._defs.items()}
+
     def help(self):
         return '\n'.join(
             'PADDLE_TPU_%s (default %r): %s' % (n.upper(), d, h)
@@ -168,6 +174,40 @@ DEFINE_int('amp_incr_every_n_steps', 1000,
 DEFINE_int('amp_decr_every_n_nan_or_inf', 2,
            'f16 mode: consecutive non-finite steps before the loss '
            'scale halves')
+DEFINE_int('fleet_replicas', 2,
+           'default replica count for inference.ServingFleet when the '
+           'constructor is not passed replicas= explicitly: the fleet '
+           'starts this many BatchingInferenceServer replicas behind '
+           'its dispatcher, and deploy() builds the same number for '
+           'the incoming version.  Only read by the fleet layer — a '
+           'bare BatchingInferenceServer never consults it, so the '
+           'single-replica serving path is untouched when no fleet is '
+           'constructed')
+DEFINE_int('fleet_unroutable_after', 3,
+           'consecutive dispatch failures before the fleet marks a '
+           'replica UNROUTABLE and stops routing to it.  Failed '
+           'requests are re-dispatched onto healthy replicas (up to '
+           'PADDLE_TPU_FLEET_RETRY_LIMIT), so clients see results, not '
+           'errors; the health-check loop keeps probing the replica '
+           'and restores it on the first successful probe')
+DEFINE_int('fleet_retry_limit', 2,
+           'how many times one request is re-dispatched onto a '
+           'DIFFERENT replica after a dispatch failure before the '
+           'client future finally carries the error.  Each retry '
+           'excludes every replica the request already failed on')
+DEFINE_float('fleet_health_interval_ms', 250.0,
+             'period of the ServingFleet health-check loop: every '
+             'interval it probes each UNROUTABLE replica with a '
+             'synthetic single-row request (zeros at the exported feed '
+             'signature) and marks the replica routable again on '
+             'success.  <=0 disables the loop (unroutable replicas '
+             'then stay out until remove/replace)')
+DEFINE_float('fleet_drain_timeout_s', 30.0,
+             'seconds a retiring replica is given to finish queued + '
+             'in-flight requests (BatchingInferenceServer.drain) '
+             'before the fleet closes it anyway — bounds how long '
+             'remove_replica(), deploy() old-version retirement, and '
+             'fleet.close() can block on a stuck replica')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -1,6 +1,8 @@
 from .serving import export_inference, load_exported, InferenceServer
 from .batching import (BatchingInferenceServer, bucket_sizes,
                        export_bucketed)
+from .fleet import ServingFleet
 
 __all__ = ['export_inference', 'load_exported', 'InferenceServer',
-           'BatchingInferenceServer', 'export_bucketed', 'bucket_sizes']
+           'BatchingInferenceServer', 'export_bucketed', 'bucket_sizes',
+           'ServingFleet']

@@ -122,6 +122,44 @@ class _ServingMetrics(object):
             'paddle_tpu_serving_batch_occupancy',
             'real rows per dispatched batch', L,
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)))
+        # queue-wait vs compute: the end-to-end request latency above
+        # splits into the time a request sat waiting to be batched and
+        # the time its batch spent on the device — labeled by the bucket
+        # it dispatched in (plus a bucket="all" rollup), so the fleet
+        # dispatcher's routing signal and the bench read the SAME
+        # numbers stats() reports
+        L2 = ('server', 'bucket')
+        self._queue_wait_family = reg.histogram(
+            'paddle_tpu_serving_queue_wait_seconds',
+            'submit-to-dispatch wait per request, by dispatched bucket '
+            '(bucket="all" aggregates)', L2,
+            buckets=_obs.DEFAULT_LATENCY_BUCKETS)
+        self._compute_family = reg.histogram(
+            'paddle_tpu_serving_compute_seconds',
+            'dispatch-to-sync device time per batch, by bucket '
+            '(bucket="all" aggregates)', L2,
+            buckets=_obs.DEFAULT_LATENCY_BUCKETS)
+        self._bucket_children = {}  # (family, bucket_label) -> child
+
+    def _bucket_child(self, family, bucket):
+        key = (family.name, str(bucket))
+        child = self._bucket_children.get(key)
+        if child is None:
+            child = family.labels(server=self._sid, bucket=str(bucket))
+            self._bucket_children[key] = child
+        return child
+
+    def queue_wait(self, bucket):
+        return self._bucket_child(self._queue_wait_family, bucket)
+
+    def compute(self, bucket):
+        return self._bucket_child(self._compute_family, bucket)
+
+    def observed_buckets(self):
+        """Bucket sizes that have dispatched at least one batch so far
+        (the stats() per-bucket iteration set)."""
+        return sorted({int(b) for (_, b) in self._bucket_children
+                       if b != 'all'})
 
     def close(self):
         """Retire this server's label series so a process cycling
@@ -130,6 +168,11 @@ class _ServingMetrics(object):
         handles stay usable for a final stats() read."""
         for m in self._families:
             m.remove(server=self._sid)
+        for fam_name, b in list(self._bucket_children):
+            fam = (self._queue_wait_family
+                   if fam_name == self._queue_wait_family.name
+                   else self._compute_family)
+            fam.remove(server=self._sid, bucket=b)
 
 
 def bucket_sizes(max_batch):
@@ -209,30 +252,66 @@ class BatchingInferenceServer(object):
     """
 
     def __init__(self, bucket_paths, max_wait_ms=5.0, linger_ms=0.5,
-                 max_queue=4096, warmup=True, latency_window=4096):
+                 max_queue=4096, warmup=True, latency_window=4096,
+                 share_artifacts_with=None, warmup_throttle_ms=0.0):
         _maybe_enable_compilation_cache()
-        if not bucket_paths:
-            raise ValueError("bucket_paths is empty")
-        self._servers = {int(b): InferenceServer(p)
-                         for b, p in bucket_paths.items()}
-        self._buckets = sorted(self._servers)
-        self.max_batch = self._buckets[-1]
-        avals = self._servers[self.max_batch].feed_avals()
-        self._feed_names = sorted(avals)
-        self._example_shapes = {
-            n: tuple(a.shape[1:]) for n, a in avals.items()}
-        self._dtypes = {n: np.dtype(a.dtype) for n, a in avals.items()}
-        for b in self._buckets:
-            av = self._servers[b].feed_avals()
-            want = {n: (b,) + self._example_shapes[n]
-                    for n in self._feed_names}
-            got = {n: tuple(a.shape) for n, a in av.items()}
-            if got != want:
+        if share_artifacts_with is not None:
+            # a sibling server over the SAME model version: reuse its
+            # deserialized artifacts and AOT-compiled executables
+            # instead of re-deserializing + re-tracing every bucket.
+            # In-process replicas (ServingFleet) are dispatch lanes
+            # over one servable — compiled executables are thread-safe
+            # and immutable, so sharing them is free, and a fleet
+            # deploy pays ONE warmup per version instead of one per
+            # replica.  The queues, worker threads, metrics, and
+            # lifecycle below stay fully per-server.
+            src = share_artifacts_with
+            if not isinstance(src, BatchingInferenceServer):
+                raise TypeError(
+                    "share_artifacts_with must be a "
+                    "BatchingInferenceServer, got %r" % (src,))
+            if bucket_paths and \
+                    sorted(int(b) for b in bucket_paths) != src._buckets:
                 raise ValueError(
-                    "bucket %d artifact feeds %s do not match the ladder "
-                    "(expected %s): every bucket must export the same "
-                    "example shapes with only the batch axis varying"
-                    % (b, got, want))
+                    "share_artifacts_with: bucket_paths ladder %s does "
+                    "not match the source server's %s — sharing is only "
+                    "valid between replicas of ONE exported version"
+                    % (sorted(int(b) for b in bucket_paths),
+                       src._buckets))
+            self._servers = src._servers
+            # the same dict object, deliberately: a bucket lazily
+            # compiled by either sibling is visible to both
+            self._compiled = src._compiled
+            self._buckets = src._buckets
+            self.max_batch = src.max_batch
+            self._feed_names = src._feed_names
+            self._example_shapes = src._example_shapes
+            self._dtypes = src._dtypes
+        else:
+            if not bucket_paths:
+                raise ValueError("bucket_paths is empty")
+            self._servers = {int(b): InferenceServer(p)
+                             for b, p in bucket_paths.items()}
+            self._compiled = {}
+            self._buckets = sorted(self._servers)
+            self.max_batch = self._buckets[-1]
+            avals = self._servers[self.max_batch].feed_avals()
+            self._feed_names = sorted(avals)
+            self._example_shapes = {
+                n: tuple(a.shape[1:]) for n, a in avals.items()}
+            self._dtypes = {n: np.dtype(a.dtype)
+                            for n, a in avals.items()}
+            for b in self._buckets:
+                av = self._servers[b].feed_avals()
+                want = {n: (b,) + self._example_shapes[n]
+                        for n in self._feed_names}
+                got = {n: tuple(a.shape) for n, a in av.items()}
+                if got != want:
+                    raise ValueError(
+                        "bucket %d artifact feeds %s do not match the "
+                        "ladder (expected %s): every bucket must "
+                        "export the same example shapes with only the "
+                        "batch axis varying" % (b, got, want))
         self.max_wait = float(max_wait_ms) / 1e3
         self.linger = float(linger_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -247,6 +326,7 @@ class BatchingInferenceServer(object):
         self._pending_rows = 0    # running row total of _pending
         self._in_flight = 0       # batches dispatched, not yet synced
         self._stopping = False
+        self._draining = False    # drain(): stop accepting, keep flushing
         # collector handoff; capacity 2 == the double-buffer window
         self._inflight_q = queue.Queue(maxsize=2)
 
@@ -257,7 +337,6 @@ class BatchingInferenceServer(object):
         # per 27-field batch)
         self._stage_to_device = jax.default_backend() != 'cpu'
 
-        self._compiled = {}
         # stats live in the observability registry (the global one when
         # metrics are enabled — labeled server="b<N>" and exported on
         # /metrics — else a private registry so stats() keeps working);
@@ -278,7 +357,15 @@ class BatchingInferenceServer(object):
             _obs.maybe_serve_from_env()
 
         if warmup:
-            for b in self._buckets:
+            # warmup_throttle_ms: pause between bucket compiles so
+            # OTHER servers' dispatch threads in this process get the
+            # cores/GIL back between bursts — a fleet building a new
+            # version next to live traffic warms gently; standalone
+            # startup (nothing else serving) keeps the default 0
+            throttle = float(warmup_throttle_ms) / 1e3
+            for i, b in enumerate(self._buckets):
+                if throttle and i and b not in self._compiled:
+                    time.sleep(throttle)
                 self._ensure_compiled(b)
         self._warmup_done = True
 
@@ -314,15 +401,18 @@ class BatchingInferenceServer(object):
     def submit(self, feed):
         """Enqueue one request; returns a Future of [output arrays],
         each keeping the request's leading row count.  Blocks only when
-        the request queue is full (backpressure)."""
+        the request queue is full (backpressure).  After :meth:`drain`
+        or :meth:`close` this raises ``RuntimeError`` immediately — a
+        request must never enqueue behind a dispatcher that is retiring
+        (its Future would hang the caller forever)."""
         norm, rows = self._normalize(feed)
         req = _Request(norm, rows, time.perf_counter())
         with self._cv:
+            self._check_accepting()
             while (len(self._pending) >= self.max_queue
-                   and not self._closed):
+                   and not self._closed and not self._draining):
                 self._cv_space.wait(0.1)
-            if self._closed:
-                raise RuntimeError("BatchingInferenceServer is closed")
+            self._check_accepting()
             self._pending.append(req)
             self._pending_rows += rows
             self._m.submitted.inc()
@@ -336,15 +426,74 @@ class BatchingInferenceServer(object):
                 self._cv.notify()
         return req.future
 
+    def _check_accepting(self):
+        """Raise the clear post-retirement error.  Caller holds _cv."""
+        if self._closed:
+            raise RuntimeError(
+                "BatchingInferenceServer is closed; submit() after "
+                "close() is rejected (the dispatcher is gone and the "
+                "request's Future would never complete)")
+        if self._draining:
+            raise RuntimeError(
+                "BatchingInferenceServer is draining; it no longer "
+                "accepts new requests (queued and in-flight work is "
+                "being flushed before retirement)")
+
     def predict(self, feed, timeout=None):
         """submit + wait: returns [output arrays] for this request."""
         return self.submit(feed).result(timeout)
+
+    def queue_state(self):
+        """Cheap live snapshot of the dispatch queue — the routing
+        signal a fleet dispatcher polls per submit: requests and rows
+        waiting to be batched, batches in flight on the device, and
+        whether this server is still accepting work.  One lock
+        acquisition, no registry reads."""
+        with self._cv:
+            return {
+                'queued_requests': len(self._pending),
+                'queued_rows': self._pending_rows,
+                'in_flight_batches': self._in_flight,
+                'accepting': not (self._closed or self._draining),
+            }
+
+    def drain(self, timeout=30.0):
+        """Stop accepting new requests and flush what is already here:
+        every queued and in-flight request still completes (partial
+        batches launch immediately — no linger / deadline wait), but
+        any further ``submit()`` raises.  Unlike :meth:`close` the
+        worker threads, compiled buckets, and metrics stay alive, so a
+        fleet can retire a replica without dropping queued requests and
+        still read its final ``stats()``.  Returns True when the queue
+        fully drained within ``timeout`` seconds (False means work was
+        still in flight — the caller may retry or close() anyway,
+        which keeps flushing).  Idempotent; drain-then-close is the
+        graceful retirement sequence."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify()           # wake the dispatcher to flush
+            self._cv_space.notify_all()  # unblock backpressured submits
+        deadline = time.perf_counter() + timeout
+        while True:
+            with self._cv:
+                if not self._pending and self._in_flight == 0:
+                    return True
+            if time.perf_counter() >= deadline:
+                return False
+            time.sleep(0.002)
 
     def stats(self):
         """The same dict shape as before the observability rebase; the
         values now read back from registry metrics (p50/p99 are
         bucket-interpolated histogram quantiles rather than exact
-        order statistics over a sliding window)."""
+        order statistics over a sliding window).
+
+        The end-to-end latency additionally splits into its two spans —
+        ``queue_wait_*`` (submit to dispatch) and ``compute_*``
+        (dispatch to host sync, per batch) — overall and under
+        ``per_bucket`` keyed by dispatched bucket size.  These read the
+        same histograms the fleet dispatcher's routing signal and
+        bench_serving report, so all three agree by construction."""
         with self._cv:
             depth = len(self._pending)
             in_flight = self._in_flight
@@ -352,6 +501,17 @@ class BatchingInferenceServer(object):
         batches = m.batches.value
         rows_sum = m.batch_rows.value
         capacity_sum = m.batch_capacity.value
+        qw, comp = m.queue_wait('all'), m.compute('all')
+        per_bucket = {}
+        for b in m.observed_buckets():
+            bq, bc = m.queue_wait(b), m.compute(b)
+            per_bucket[b] = {
+                'queue_wait_p50_ms': bq.quantile(0.5) * 1e3,
+                'queue_wait_p99_ms': bq.quantile(0.99) * 1e3,
+                'compute_p50_ms': bc.quantile(0.5) * 1e3,
+                'compute_p99_ms': bc.quantile(0.99) * 1e3,
+                'batches': int(bc.count),
+            }
         return {
             'queue_depth': depth,
             'in_flight_batches': in_flight,
@@ -367,6 +527,11 @@ class BatchingInferenceServer(object):
                 int(m.compiles_after_warmup.value),
             'p50_latency_ms': m.latency.quantile(0.5) * 1e3,
             'p99_latency_ms': m.latency.quantile(0.99) * 1e3,
+            'queue_wait_p50_ms': qw.quantile(0.5) * 1e3,
+            'queue_wait_p99_ms': qw.quantile(0.99) * 1e3,
+            'compute_p50_ms': comp.quantile(0.5) * 1e3,
+            'compute_p99_ms': comp.quantile(0.99) * 1e3,
+            'per_bucket': per_bucket,
             'buckets': list(self._buckets),
         }
 
@@ -516,6 +681,8 @@ class BatchingInferenceServer(object):
             return False  # double-buffer window full: wait for a sync
         if grew_full:
             return True   # bucket can't grow: launch immediately
+        if self._draining or self._stopping:
+            return True   # retiring: flush partials, don't linger
         if self._in_flight == 0 and now - t_first >= self.linger:
             return True   # device idle: don't hoard a partial batch
         return now - t_first >= self.max_wait  # deadline flush
@@ -575,18 +742,27 @@ class BatchingInferenceServer(object):
                 self._cv.notify()
             return
         rows = offsets[-1][1]
+        t_launch = time.perf_counter()
         self._m.batches.inc()
         self._m.batch_rows.inc(rows)
         self._m.batch_capacity.inc(bucket)
         self._m.occupancy.observe(rows)
-        self._inflight_q.put((outs, reqs, offsets))
+        # queue wait ends at dispatch: per request, labeled by the
+        # bucket it rode out in (plus the "all" rollup)
+        qw_b = self._m.queue_wait(bucket)
+        qw_all = self._m.queue_wait('all')
+        for r in reqs:
+            w = t_launch - r.t_submit
+            qw_b.observe(w)
+            qw_all.observe(w)
+        self._inflight_q.put((outs, reqs, offsets, bucket, t_launch))
 
     def _collect_loop(self):
         while True:
             item = self._inflight_q.get()
             if item is _STOP:
                 return
-            outs, reqs, offsets = item
+            outs, reqs, offsets, bucket, t_launch = item
             try:
                 host = [np.asarray(o) for o in outs]
             except Exception as e:  # pragma: no cover - defensive
@@ -604,6 +780,9 @@ class BatchingInferenceServer(object):
                 self._m.in_flight.set(self._in_flight)
                 self._cv.notify()
             now = time.perf_counter()
+            # compute span = dispatch to host sync, one sample per batch
+            self._m.compute(bucket).observe(now - t_launch)
+            self._m.compute('all').observe(now - t_launch)
             self._m.completed.inc(len(reqs))
             for r in reqs:
                 self._m.latency.observe(now - r.t_submit)

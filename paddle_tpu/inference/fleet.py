@@ -1,0 +1,754 @@
+"""Serving fleet: N batching replicas behind one dispatcher.
+
+``inference/batching.py`` is a strong single-replica core — AOT-warmed
+buckets, work-conserving dispatch, p99 in the milliseconds — but it is
+one process-local serving loop with one model version and no story for
+replica failure or rollout.  Production traffic needs the layer above,
+in the style of versioned-servable model servers (TF-Serving's
+servable/version manager) and load-aware replica dispatch (Clipper):
+
+- **Queue-depth routing**: every ``submit()`` routes to the READY
+  replica with the least work outstanding — queued rows plus in-flight
+  batches weighted by the bucket ladder top, read straight from each
+  replica's :meth:`~BatchingInferenceServer.queue_state` (one lock per
+  replica, the same numbers its ``stats()``/queue-wait histograms
+  report).  Ties rotate round-robin so idle fleets don't pile onto
+  replica 0.
+- **Failure containment**: a dispatch failure never reaches the client
+  first — the request is re-dispatched onto a different replica (up to
+  ``PADDLE_TPU_FLEET_RETRY_LIMIT`` times, each retry excluding every
+  replica it already failed on) while the failing replica accumulates a
+  strike count; at ``PADDLE_TPU_FLEET_UNROUTABLE_AFTER`` consecutive
+  failures it is marked UNROUTABLE and drops out of routing.  A
+  background **health-check loop** probes unroutable replicas with a
+  synthetic single-row request and restores them on the first success.
+- **Versioned hot-swap**: :meth:`ServingFleet.deploy` loads a new
+  ``export_bucketed`` artifact directory (``io.resolve_version_dir``
+  understands both a bare artifact dir and a TF-Serving-style base dir
+  of numbered versions), builds and **warms a full replica set in the
+  background** — the old version keeps serving; with a persistent
+  compile cache (``PADDLE_TPU_COMPILATION_CACHE_DIR``) warmup is disk
+  reads and the new replicas report zero post-warmup compiles — then
+  atomically flips routing and drains the old replicas so their queued
+  and in-flight requests all complete.  Zero requests are dropped at
+  the flip by construction: every request holds a Future bound to
+  whichever replica set it was routed into.  In-process replicas of
+  one version **share one compiled servable**
+  (``BatchingInferenceServer(share_artifacts_with=...)``): a version's
+  deserialize + trace + compile cost is paid once per deploy, not once
+  per replica, and that one build runs on a background-priority
+  thread with throttled bucket compiles so the live serving threads
+  keep the cores mid-rollout.
+- **Rollback**: each deploy records ``{version, dir}`` through
+  ``io.write_rollback_json`` — the same ``.prev`` archive protocol the
+  checkpoint manifest and STEP files use — so :meth:`rollback` re-opens
+  the previous deployment record and hot-swaps back to it.
+- **Elasticity**: :meth:`add_replica` builds + warms a replica of the
+  live version and only then makes it routable (a cold replica never
+  sees a routed request before its buckets are compiled);
+  :meth:`remove_replica` drains one out gracefully.
+
+Fleet telemetry lands in the observability registry labeled
+``fleet``/``replica``/``version`` (per-replica dispatch counters keep
+their version label across hot-swaps, so a rollout is visible in
+/metrics as one label series handing off to another), plus pull-style
+**callback gauges** for the aggregate queue depth / in-flight /
+replica-state counts — read live at scrape time instead of
+push-updated on every transition.
+
+The fleet is opt-in and additive: nothing here is imported on the
+single-replica path, and a bare ``BatchingInferenceServer`` behaves
+byte-for-byte as before when no fleet is constructed.
+"""
+import itertools
+import os
+import tempfile
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import io as _io
+from .. import observability as _obs
+from ..flags import FLAGS
+from .batching import BatchingInferenceServer
+
+__all__ = ['ServingFleet']
+
+_fleet_seq = itertools.count()
+_replica_seq = itertools.count()
+
+# replica lifecycle states
+READY = 'ready'            # routable
+UNROUTABLE = 'unroutable'  # out of routing; health loop probes it
+DRAINING = 'draining'      # retiring: flushing queued + in-flight work
+RETIRED = 'retired'        # closed; kept only in stats history
+
+_STATES = (READY, UNROUTABLE, DRAINING)
+
+
+def _run_backgrounded(fn):
+    """Run ``fn`` on a throwaway thread at the lowest OS scheduling
+    priority (per-thread nice 19 on Linux) and return its result,
+    re-raising its exception.  Replica warmup is CPU-hungry (artifact
+    deserialization, tracing, compile-cache loads) and must not steal
+    cores from the serving threads mid-rollout; nice is raise-only, so
+    it is applied to a thread we then discard — never to the caller's.
+    Falls back to plain execution where unsupported."""
+    box = {}
+
+    def work():
+        try:
+            os.setpriority(os.PRIO_PROCESS, threading.get_native_id(),
+                           19)
+        except (AttributeError, OSError):
+            pass  # non-Linux / not permitted: run at normal priority
+        try:
+            box['result'] = fn()
+        except BaseException as e:  # noqa: B036 — re-raised below
+            box['error'] = e
+
+    t = threading.Thread(target=work,
+                         name='paddle-tpu-fleet-warmup', daemon=True)
+    t.start()
+    t.join()
+    if 'error' in box:
+        raise box['error']
+    return box['result']
+
+
+class _Replica(object):
+    """One BatchingInferenceServer plus its fleet-side lifecycle."""
+    __slots__ = ('rid', 'version', 'version_dir', 'server', 'state',
+                 'failures', 'probe_feed', 'warmup_s', 'm_dispatch',
+                 'm_dispatch_failures')
+
+    def __init__(self, rid, version, version_dir, server, probe_feed,
+                 warmup_s):
+        self.rid = rid
+        self.version = version
+        self.version_dir = version_dir
+        self.server = server
+        self.state = READY
+        self.failures = 0
+        self.probe_feed = probe_feed
+        self.warmup_s = warmup_s
+        self.m_dispatch = None           # set by _FleetMetrics.bind
+        self.m_dispatch_failures = None
+
+
+class _FleetMetrics(object):
+    """Fleet-level handles into a metrics registry: counters labeled
+    ``fleet=<fid>``, per-replica dispatch counters additionally labeled
+    ``replica``/``version``, and pull-style callback gauges for the
+    aggregates (wired to ``fns`` at construction, read live at scrape
+    time).  Reports into a private registry when observability is
+    disabled, exactly like the batching server's metrics — ``stats()``
+    keeps working, nothing is exported."""
+
+    def __init__(self, reg, fid, fns):
+        L = ('fleet',)
+        LR = ('fleet', 'replica', 'version')
+        self._reg = reg
+        self._fid = fid
+        self._families = []
+        self._replica_families = []
+
+        def child(metric):
+            self._families.append(metric)
+            return metric.labels(fleet=fid)
+
+        self.requests = child(reg.counter(
+            'paddle_tpu_fleet_requests_total',
+            'requests accepted by the fleet dispatcher', L))
+        self.completed = child(reg.counter(
+            'paddle_tpu_fleet_requests_completed_total',
+            'requests whose results were delivered to clients', L))
+        self.failed = child(reg.counter(
+            'paddle_tpu_fleet_requests_failed_total',
+            'requests whose clients finally saw an error (after all '
+            'retries)', L))
+        self.retries = child(reg.counter(
+            'paddle_tpu_fleet_retries_total',
+            'request re-dispatches onto another replica after a '
+            'dispatch failure', L))
+        self.deploys = child(reg.counter(
+            'paddle_tpu_fleet_deploys_total',
+            'version deployments (hot-swaps) completed', L))
+        self.rollbacks = child(reg.counter(
+            'paddle_tpu_fleet_rollbacks_total',
+            'deployments that were rollbacks to the archived previous '
+            'version', L))
+        self.unroutable_marks = child(reg.counter(
+            'paddle_tpu_fleet_unroutable_marks_total',
+            'replica transitions into the unroutable state', L))
+        self.probes = child(reg.counter(
+            'paddle_tpu_fleet_health_probes_total',
+            'health-check probes sent to unroutable replicas', L))
+        self.probe_failures = child(reg.counter(
+            'paddle_tpu_fleet_health_probe_failures_total',
+            'health-check probes that failed (replica stays '
+            'unroutable)', L))
+
+        self._dispatches = reg.counter(
+            'paddle_tpu_fleet_dispatches_total',
+            'requests dispatched per replica (version-labeled, so a '
+            'rollout reads as one series handing off to another)', LR)
+        self._dispatch_failures = reg.counter(
+            'paddle_tpu_fleet_dispatch_failures_total',
+            'dispatch failures per replica', LR)
+
+        # pull-style aggregates: live fleet state read at scrape time
+        self._g_queue = reg.gauge(
+            'paddle_tpu_fleet_queued_rows',
+            'rows waiting across every routable replica queue '
+            '(callback gauge, read live)', L)
+        self._families.append(self._g_queue)
+        self._g_queue.labels(fleet=fid).set_function(fns['queued_rows'])
+        self._g_inflight = reg.gauge(
+            'paddle_tpu_fleet_in_flight_batches',
+            'batches in flight across every routable replica '
+            '(callback gauge, read live)', L)
+        self._families.append(self._g_inflight)
+        self._g_inflight.labels(fleet=fid).set_function(fns['in_flight'])
+        self._g_replicas = reg.gauge(
+            'paddle_tpu_fleet_replicas',
+            'replica count per lifecycle state (callback gauge)',
+            ('fleet', 'state'))
+        self._replica_state_labels = []
+        for st in _STATES:
+            self._g_replicas.labels(fleet=fid, state=st).set_function(
+                fns['state_count'](st))
+            self._replica_state_labels.append(st)
+
+    def bind(self, rep):
+        """Create (and attach) the per-replica counter children."""
+        kv = dict(fleet=self._fid, replica=rep.rid, version=rep.version)
+        rep.m_dispatch = self._dispatches.labels(**kv)
+        rep.m_dispatch_failures = self._dispatch_failures.labels(**kv)
+        self._replica_families.append((self._dispatches, kv))
+        self._replica_families.append((self._dispatch_failures, kv))
+
+    def unbind(self, rep):
+        """Retire a replica's label series (handles stay readable)."""
+        kv = dict(fleet=self._fid, replica=rep.rid, version=rep.version)
+        for fam in (self._dispatches, self._dispatch_failures):
+            fam.remove(**kv)
+            try:
+                self._replica_families.remove((fam, kv))
+            except ValueError:
+                pass
+
+    def close(self):
+        for m in self._families:
+            m.remove(fleet=self._fid)
+        for fam, kv in self._replica_families:
+            fam.remove(**kv)
+        self._replica_families = []
+        for st in self._replica_state_labels:
+            self._g_replicas.remove(fleet=self._fid, state=st)
+
+
+class ServingFleet(object):
+    """N ``BatchingInferenceServer`` replicas of one model version
+    behind a queue-depth-aware dispatcher, with replica lifecycle
+    management and versioned hot-swap.
+
+    ``version_dir`` is an ``export_bucketed`` output directory, or a
+    base directory of numbered version subdirectories (highest number
+    serves, TF-Serving style); ``version=`` pins a specific subdir.
+
+    - ``submit(feed)`` -> Future (thread-safe); ``predict`` is
+      submit + wait.  Requests are routed to the least-loaded READY
+      replica; a dispatch failure is retried on another replica before
+      the client ever sees an error.
+    - ``deploy(new_version_dir)`` hot-swaps the model: build + warm a
+      fresh replica set for the new version (old version keeps
+      serving), atomically flip routing, drain the old replicas.
+      ``rollback()`` re-deploys the archived previous version.
+    - ``add_replica()`` / ``remove_replica()`` scale the live set;
+      a new replica becomes routable only after its warmup finished.
+    - ``stats()`` aggregates per-replica queue/latency/compile stats.
+
+    Remaining keyword arguments (``max_wait_ms``, ``linger_ms``,
+    ``max_queue``, ...) pass through to every replica's
+    ``BatchingInferenceServer``.
+    """
+
+    def __init__(self, version_dir, replicas=None, version=None,
+                 state_dir=None, unroutable_after=None, retry_limit=None,
+                 health_interval_ms=None, drain_timeout_s=None,
+                 **server_kwargs):
+        self._fid = 'f%d' % next(_fleet_seq)
+        self._lock = threading.Lock()
+        self._deploy_lock = threading.Lock()
+        self._rr = itertools.count()
+        self._server_kwargs = dict(server_kwargs)
+        self._default_replicas = int(
+            replicas if replicas is not None else FLAGS.fleet_replicas)
+        if self._default_replicas < 1:
+            raise ValueError("a fleet needs at least 1 replica, got %d"
+                             % self._default_replicas)
+        self._unroutable_after = int(
+            unroutable_after if unroutable_after is not None
+            else FLAGS.fleet_unroutable_after)
+        self._retry_limit = int(
+            retry_limit if retry_limit is not None
+            else FLAGS.fleet_retry_limit)
+        self._health_interval = float(
+            health_interval_ms if health_interval_ms is not None
+            else FLAGS.fleet_health_interval_ms) / 1e3
+        self._drain_timeout = float(
+            drain_timeout_s if drain_timeout_s is not None
+            else FLAGS.fleet_drain_timeout_s)
+        self._probe_timeout = max(5.0, self._health_interval * 4)
+
+        self._replicas = []      # the routable set (READY/UNROUTABLE)
+        self._version = None
+        self._version_dir = None
+        self._deploy_seq = 0
+        self._closed = False
+
+        self._owned_state_dir = None
+        if state_dir is None:
+            state_dir = tempfile.mkdtemp(prefix='paddle_tpu_fleet_')
+            self._owned_state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self._deploy_record = os.path.join(state_dir, 'DEPLOY.json')
+
+        reg = _obs.registry() if _obs.enabled() \
+            else _obs.MetricsRegistry()
+        self._m = _FleetMetrics(reg, self._fid, {
+            'queued_rows': lambda: self._aggregate('queued_rows'),
+            'in_flight': lambda: self._aggregate('in_flight_batches'),
+            'state_count': lambda st: (lambda: self._state_count(st)),
+        })
+        if _obs.enabled():
+            _obs.maybe_serve_from_env()
+
+        try:
+            self.deploy(version_dir, replicas=self._default_replicas,
+                        version=version)
+        except Exception:
+            self._m.close()
+            self._rm_owned_state_dir()
+            raise
+
+        self._stop = threading.Event()
+        self._health_thread = None
+        if self._health_interval > 0:
+            self._health_thread = threading.Thread(
+                target=self._health_loop,
+                name='paddle-tpu-fleet-health', daemon=True)
+            self._health_thread.start()
+
+    # -- client surface ------------------------------------------------
+    def submit(self, feed):
+        """Route one request onto the least-loaded replica; returns a
+        Future of [output arrays].  The Future only carries an
+        exception after the fleet ran out of retry budget AND distinct
+        replicas — a single replica failure is invisible to clients."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ServingFleet is closed")
+        fut = Future()
+        self._m.requests.inc()
+        self._dispatch(feed, fut, frozenset(), 0, None)
+        return fut
+
+    def predict(self, feed, timeout=None):
+        """submit + wait: returns [output arrays] for this request."""
+        return self.submit(feed).result(timeout)
+
+    # -- routing -------------------------------------------------------
+    def _pick(self, tried):
+        """Least-outstanding-work READY replica not in ``tried``:
+        score = queued rows + in-flight batches x ladder top (a batch
+        on the device occupies up to a full bucket).  Equal scores
+        rotate round-robin.  Returns None when no candidate exists."""
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if r.state == READY and r.rid not in tried]
+            if not cands:
+                return None
+            offset = next(self._rr)
+            best, best_key = None, None
+            for i, r in enumerate(cands):
+                qs = r.server.queue_state()
+                if not qs['accepting']:
+                    continue
+                score = (qs['queued_rows'] + qs['in_flight_batches']
+                         * r.server.max_batch)
+                key = (score, (i + offset) % len(cands))
+                if best_key is None or key < best_key:
+                    best, best_key = r, key
+            return best
+
+    def _dispatch(self, feed, fut, tried, attempts, last_exc):
+        """Try replicas until one accepts the request (its Future then
+        drives completion via _on_done) or the retry budget is spent."""
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                self._m.failed.inc()
+                fut.set_exception(last_exc or RuntimeError(
+                    "ServingFleet %s has no routable replica (all "
+                    "unroutable/draining or already tried for this "
+                    "request)" % self._fid))
+                return
+            try:
+                inner = rep.server.submit(feed)
+            except Exception as e:
+                # submit itself failed (replica raced into drain/close,
+                # or rejected the request shape).  Validation errors are
+                # deterministic — every replica would reject them — so
+                # ValueError propagates to the client unretried.
+                if isinstance(e, ValueError):
+                    fut.set_exception(e)
+                    return
+                self._note_failure(rep)
+                tried = tried | {rep.rid}
+                last_exc = e
+                if attempts >= self._retry_limit:
+                    self._m.failed.inc()
+                    fut.set_exception(e)
+                    return
+                attempts += 1
+                self._m.retries.inc()
+                continue
+            rep.m_dispatch.inc()
+            inner.add_done_callback(
+                lambda f, rep=rep, tried=tried, attempts=attempts:
+                self._on_done(rep, feed, fut, tried, attempts, f))
+            return
+
+    def _on_done(self, rep, feed, fut, tried, attempts, inner):
+        """Runs in the replica's collector thread when its Future
+        resolves: deliver, or strike the replica and re-dispatch."""
+        exc = inner.exception()
+        if exc is None:
+            self._note_success(rep)
+            self._m.completed.inc()
+            fut.set_result(inner.result())
+            return
+        rep.m_dispatch_failures.inc()
+        self._note_failure(rep)
+        if attempts >= self._retry_limit:
+            self._m.failed.inc()
+            fut.set_exception(exc)
+            return
+        self._m.retries.inc()
+        self._dispatch(feed, fut, tried | {rep.rid}, attempts + 1, exc)
+
+    def _note_failure(self, rep):
+        with self._lock:
+            if rep.state not in (READY, UNROUTABLE):
+                return  # draining/retired replicas aren't struck
+            rep.failures += 1
+            if rep.failures >= self._unroutable_after \
+                    and rep.state == READY:
+                rep.state = UNROUTABLE
+                self._m.unroutable_marks.inc()
+
+    def _note_success(self, rep):
+        with self._lock:
+            rep.failures = 0
+            if rep.state == UNROUTABLE:
+                rep.state = READY
+
+    # -- health --------------------------------------------------------
+    def _health_loop(self):
+        """Probe unroutable replicas with a synthetic request; restore
+        them on the first success.  Probes ride the replica's normal
+        serving loop, so a success proves the whole dispatch path."""
+        while not self._stop.wait(self._health_interval):
+            with self._lock:
+                bad = [r for r in self._replicas
+                       if r.state == UNROUTABLE]
+            for rep in bad:
+                self._m.probes.inc()
+                try:
+                    rep.server.predict(rep.probe_feed,
+                                       timeout=self._probe_timeout)
+                except Exception:
+                    self._m.probe_failures.inc()
+                else:
+                    self._note_success(rep)
+
+    # -- replica lifecycle ---------------------------------------------
+    def _new_replica(self, vname, vdir, paths, share_with=None):
+        """Build one replica.  ``share_with`` (a sibling replica of the
+        SAME version) makes the new server share the sibling's
+        deserialized artifacts and compiled executables — in-process
+        replicas are dispatch lanes over one servable, so a version's
+        warmup cost is paid once, not once per replica, and the
+        serving threads are disturbed for one build, not N."""
+        rid = 'r%d' % next(_replica_seq)
+        t0 = time.perf_counter()
+        kw = dict(self._server_kwargs)
+        kw.setdefault('warmup', True)
+        if share_with is not None:
+            kw['share_artifacts_with'] = share_with.server
+        elif self._replicas:
+            # building a fresh servable NEXT TO live traffic (deploy,
+            # cold add): throttle the bucket compiles so the serving
+            # threads get the cores back between bursts
+            kw.setdefault('warmup_throttle_ms', 100.0)
+        server = BatchingInferenceServer(paths, **kw)
+        warmup_s = time.perf_counter() - t0
+        probe = {n: np.zeros((1,) + shape, server._dtypes[n])
+                 for n, shape in server._example_shapes.items()}
+        rep = _Replica(rid, vname, vdir, server, probe, warmup_s)
+        self._m.bind(rep)
+        return rep
+
+    def add_replica(self):
+        """Add one routable replica of the live version.  When a live
+        sibling of the same version exists, the new replica shares its
+        compiled artifacts (serving-ready immediately); a genuinely
+        cold build AOT-warms first — routing only ever sees the replica
+        after warmup, so with a warm persistent compile cache a cold
+        replica reaches serving-ready with zero post-warmup compiles
+        and zero compiles paid in the serving loop.  Returns the
+        replica id."""
+        with self._deploy_lock:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("ServingFleet is closed")
+                vname, vdir = self._version, self._version_dir
+                share = next(
+                    (r for r in self._replicas
+                     if r.version == vname
+                     and r.state in (READY, UNROUTABLE)), None)
+            paths = _io.bucket_artifacts(vdir)
+            rep = _run_backgrounded(
+                lambda: self._new_replica(vname, vdir, paths,
+                                          share_with=share))
+            with self._lock:
+                if self._closed:
+                    closed = True
+                else:
+                    closed = False
+                    self._replicas.append(rep)
+            if closed:
+                # close() raced the build: don't leak the replica
+                self._retire([rep])
+                raise RuntimeError("ServingFleet is closed")
+            return rep.rid
+
+    def remove_replica(self, rid=None):
+        """Gracefully retire one replica: take it out of routing, drain
+        its queued + in-flight requests (nothing is dropped), close it.
+        ``rid=None`` removes the most recently added.  Refuses to
+        remove the last replica (use close()).  Serialized against
+        deploy/add (``_deploy_lock``) so a removal can't be silently
+        undone by a concurrent deploy's wholesale set swap."""
+        with self._deploy_lock:
+            with self._lock:
+                if len(self._replicas) <= 1:
+                    raise ValueError(
+                        "cannot remove the last replica of fleet %s — "
+                        "close() the fleet instead" % self._fid)
+                if rid is None:
+                    rep = self._replicas[-1]
+                else:
+                    match = [r for r in self._replicas
+                             if r.rid == rid]
+                    if not match:
+                        raise ValueError("no replica %r in fleet %s"
+                                         % (rid, self._fid))
+                    rep = match[0]
+                self._replicas.remove(rep)
+                rep.state = DRAINING
+            self._retire([rep])
+            return rep.rid
+
+    def _retire(self, reps):
+        """Drain-then-close a batch of replicas (deploy's old set,
+        remove_replica, close).  Queued and in-flight requests all
+        complete; only the label series are retired."""
+        for rep in reps:
+            with self._lock:
+                rep.state = DRAINING
+            rep.server.drain(timeout=self._drain_timeout)
+            rep.server.close()
+            with self._lock:
+                rep.state = RETIRED
+            self._m.unbind(rep)
+
+    # -- versioned deployment ------------------------------------------
+    def deploy(self, version_dir, replicas=None, version=None):
+        """Hot-swap the model version with zero dropped requests:
+
+        1. resolve ``version_dir`` (``io.resolve_version_dir``);
+        2. build + AOT-warm a full replica set for it — the serving
+           set is untouched, traffic keeps flowing;
+        3. atomically flip routing to the new set;
+        4. record the deployment (``io.write_rollback_json`` archives
+           the superseded record as ``.prev`` — rollback() reads it);
+        5. drain + close the old set (their queued work completes).
+
+        Returns the deployed version name.  Serialized against
+        concurrent deploy/add/remove; client submits never block on
+        it."""
+        with self._deploy_lock:
+            vdir, vname = _io.resolve_version_dir(version_dir, version)
+            paths = _io.bucket_artifacts(vdir)
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("ServingFleet is closed")
+                n = (int(replicas) if replicas is not None
+                     else (len(self._replicas)
+                           or self._default_replicas))
+            new = []
+            try:
+                for _ in range(n):
+                    # the first replica pays the (compile-cache-backed)
+                    # warmup — on a background-priority thread so the
+                    # live serving threads keep the cores mid-rollout;
+                    # its siblings share the compiled servable
+                    new.append(_run_backgrounded(
+                        lambda: self._new_replica(
+                            vname, vdir, paths,
+                            share_with=new[0] if new else None)))
+            except Exception:
+                self._retire(new)
+                raise
+            with self._lock:
+                # re-check under the lock: close() may have raced the
+                # (long) build — it retired the old set already, so
+                # flipping now would leak live replicas into a fleet
+                # that reports closed
+                aborted = self._closed
+                if not aborted:
+                    old = self._replicas
+                    self._replicas = new
+                    self._version = vname
+                    self._version_dir = vdir
+                    self._deploy_seq += 1
+                    seq = self._deploy_seq
+            if aborted:
+                self._retire(new)
+                raise RuntimeError("ServingFleet is closed")
+            _io.write_rollback_json(self._deploy_record, {
+                'version': vname, 'dir': os.path.abspath(vdir),
+                'replicas': n, 'seq': seq})
+            self._m.deploys.inc()
+            self._retire(old)
+            return vname
+
+    def rollback(self):
+        """Hot-swap back to the previous deployment, read from the
+        ``.prev`` archive of the deploy record (the io.py manifest/
+        ``.prev`` protocol).  Two rollbacks in a row toggle between the
+        last two versions — each deploy re-archives what it replaced.
+        Returns the restored version name."""
+        rec = _io.read_rollback_json(self._deploy_record, prev=True)
+        if rec is None:
+            raise RuntimeError(
+                "fleet %s has no previous deployment to roll back to "
+                "(the deploy record has no .prev archive yet)"
+                % self._fid)
+        self._m.rollbacks.inc()
+        return self.deploy(rec['dir'],
+                           replicas=rec.get('replicas'))
+
+    # -- introspection -------------------------------------------------
+    def _aggregate(self, field):
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if r.state in (READY, UNROUTABLE)]
+        return sum(r.server.queue_state()[field] for r in reps)
+
+    def _state_count(self, state):
+        with self._lock:
+            return sum(1 for r in self._replicas if r.state == state)
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    @property
+    def replica_ids(self):
+        with self._lock:
+            return [r.rid for r in self._replicas]
+
+    def stats(self):
+        """Fleet-wide aggregate + per-replica detail.  The per-replica
+        ``server`` sub-dicts are each replica's own ``stats()`` (same
+        shapes as the single-server API, queue-wait/compute split
+        included), so the routing signal, /metrics, and this dict all
+        read the same registry."""
+        with self._lock:
+            reps = list(self._replicas)
+            version = self._version
+        per = []
+        for r in reps:
+            s = r.server.stats()
+            per.append({
+                'id': r.rid, 'version': r.version, 'state': r.state,
+                'failures': r.failures,
+                'warmup_s': round(r.warmup_s, 3),
+                'compiles': s['compiles'],
+                'compiles_after_warmup': s['compiles_after_warmup'],
+                'queue': r.server.queue_state(),
+                'server': s,
+            })
+        m = self._m
+        return {
+            'fleet': self._fid,
+            'version': version,
+            'replicas': per,
+            'ready': sum(1 for p in per if p['state'] == READY),
+            'unroutable':
+                sum(1 for p in per if p['state'] == UNROUTABLE),
+            'queued_rows': sum(p['queue']['queued_rows'] for p in per),
+            'in_flight_batches':
+                sum(p['queue']['in_flight_batches'] for p in per),
+            'requests': int(m.requests.value),
+            'completed': int(m.completed.value),
+            'failed': int(m.failed.value),
+            'retries': int(m.retries.value),
+            'deploys': int(m.deploys.value),
+            'rollbacks': int(m.rollbacks.value),
+            'unroutable_marks': int(m.unroutable_marks.value),
+            'health_probes': int(m.probes.value),
+        }
+
+    # -- shutdown ------------------------------------------------------
+    def _rm_owned_state_dir(self):
+        if self._owned_state_dir:
+            import shutil
+            shutil.rmtree(self._owned_state_dir, ignore_errors=True)
+
+    def close(self):
+        """Retire every replica (drain first — queued work completes),
+        stop the health loop, and release the fleet's metric series.
+        Setting ``_closed`` first stops new submits and makes any
+        in-flight deploy/add abort at its flip re-check; the
+        ``_deploy_lock`` below then waits that operation out, so its
+        freshly built replicas are retired (by it) before the state
+        dir and metric series go away."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            reps = self._replicas
+            self._replicas = []
+        if self._health_thread is not None:
+            self._stop.set()
+            self._health_thread.join(
+                max(1.0, self._health_interval * 4))
+        self._retire(reps)
+        with self._deploy_lock:
+            pass  # barrier: an in-flight deploy/add finishes aborting
+        self._m.close()
+        self._rm_owned_state_dir()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

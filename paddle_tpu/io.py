@@ -18,6 +18,8 @@ changed program fails loudly instead of corrupting the scope.
 """
 import json
 import os
+import re
+import time
 
 import numpy as np
 
@@ -40,6 +42,8 @@ __all__ = [
     'load_inference_model', 'get_inference_program',
     'get_parameter_value', 'get_parameter_value_by_name', 'is_parameter',
     'is_persistable', 'save_checkpoint', 'load_checkpoint',
+    'rollback_checkpoint', 'bucket_artifacts', 'resolve_version_dir',
+    'write_rollback_json', 'read_rollback_json',
 ]
 
 
@@ -171,13 +175,13 @@ def _merge_var_record(old, new):
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, generation=None):
+              predicate=None, generation=None, scope=None):
     if vars is None:
         if main_program is None:
             main_program = default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
     os.makedirs(dirname, exist_ok=True)
-    scope = global_scope()
+    scope = scope or global_scope()
     # Seed var records from THIS process's previous manifest only —
     # copying siblings' shard records into our manifest would let a torn
     # later checkpoint (another host crashing mid-save) pass the
@@ -406,15 +410,23 @@ def _advances_generation(path, manifest):
     ADVICE.md flags).  Only an equal generation — a re-save of the same
     checkpoint, e.g. per-member saves composing one generation — leaves
     the archive alone."""
-    def newest(m):
-        return max([r.get('gen', 0) or 0
-                    for r in m.get('vars', {}).values()] + [0])
     try:
         with open(path) as f:
             on_disk = json.load(f)
     except (OSError, ValueError):
         return True
-    return newest(manifest) != newest(on_disk)
+    return (_newest_generation(manifest)
+            != _newest_generation(on_disk))
+
+
+def _newest_generation(manifest):
+    """The highest save generation any var record carries (0 for legacy
+    / empty manifests) — the value the step->generation binding in
+    load_checkpoint and the archive gate both compare."""
+    if not manifest:
+        return 0
+    return max([r.get('gen', 0) or 0
+                for r in manifest.get('vars', {}).values()] + [0])
 
 
 def _read_manifest(dirname, own_only=False):
@@ -453,15 +465,17 @@ def _read_manifest(dirname, own_only=False):
     return merged
 
 
-def save_params(executor, dirname, main_program=None, generation=None):
+def save_params(executor, dirname, main_program=None, generation=None,
+                scope=None):
     save_vars(executor, dirname, main_program, vars=None,
-              predicate=is_parameter, generation=generation)
+              predicate=is_parameter, generation=generation, scope=scope)
 
 
 def save_persistables(executor, dirname, main_program=None,
-                      generation=None):
+                      generation=None, scope=None):
     save_vars(executor, dirname, main_program, vars=None,
-              predicate=is_persistable, generation=generation)
+              predicate=is_persistable, generation=generation,
+              scope=scope)
 
 
 def _check_against_program(name, var, shape, dtype):
@@ -583,17 +597,23 @@ def _assemble(shape, dtype, shard_files):
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None):
+              predicate=None, scope=None, manifest=None):
     """Returns the number of vars actually restored (a var absent from
     the directory is skipped — partial checkpoints are legal for
     fine-tuning — but callers like load_checkpoint can detect a total
-    miss, e.g. a program whose auto-generated names don't line up)."""
+    miss, e.g. a program whose auto-generated names don't line up).
+
+    ``manifest`` lets a caller pin the exact manifest the load resolves
+    against (load_checkpoint's consistency loop reads it once, loads,
+    then re-validates — a second internal read here would reopen the
+    race it closes); None reads the directory as before."""
     if vars is None:
         if main_program is None:
             main_program = default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
-    scope = global_scope()
-    manifest = _read_manifest(dirname)
+    scope = scope or global_scope()
+    if manifest is None:
+        manifest = _read_manifest(dirname)
     records = manifest['vars'] if manifest else {}
     loaded = 0
     for var in vars:
@@ -623,13 +643,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     return loaded
 
 
-def load_params(executor, dirname, main_program=None):
-    load_vars(executor, dirname, main_program, predicate=is_parameter)
+def load_params(executor, dirname, main_program=None, scope=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              scope=scope)
 
 
-def load_persistables(executor, dirname, main_program=None):
+def load_persistables(executor, dirname, main_program=None, scope=None,
+                      manifest=None):
     return load_vars(executor, dirname, main_program,
-                     predicate=is_persistable)
+                     predicate=is_persistable, scope=scope,
+                     manifest=manifest)
 
 
 def load_persistables_if_exist(executor, dirname, main_program=None):
@@ -734,27 +757,227 @@ def write_step_file(dirname, step):
     os.replace(tmp, path)
 
 
-def save_checkpoint(executor, dirname, main_program=None, step=None):
+def save_checkpoint(executor, dirname, main_program=None, step=None,
+                    scope=None):
     """Full training state: every persistable (params + optimizer moments +
     bn stats + counters).  ``step`` doubles as the save-generation logical
     clock: every host of a synchronized save passes the same step, so the
     manifest merge is race-free even across host-count changes."""
     save_persistables(executor, dirname, main_program,
-                      generation=step_generation(step))
+                      generation=step_generation(step), scope=scope)
     if step is not None:
         write_step_file(dirname, step)
 
 
-def load_checkpoint(executor, dirname, main_program=None):
-    n = load_persistables(executor, dirname, main_program)
-    if n == 0:
-        raise ValueError(
-            "checkpoint %s restored nothing — no persistable var of the "
-            "program matches a saved name (was the program rebuilt with "
-            "different auto-generated names? build it under "
-            "reset_unique_name_guard() for stable names)" % dirname)
-    step_file = os.path.join(dirname, 'STEP')
-    if os.path.exists(step_file):
-        with open(step_file) as f:
+def _read_step_file(dirname, prev=False):
+    path = os.path.join(dirname, 'STEP' + ('.prev' if prev else ''))
+    try:
+        with open(path) as f:
             return int(f.read().strip())
-    return None
+    except (OSError, ValueError):
+        return None
+
+
+def load_checkpoint(executor, dirname, main_program=None, scope=None):
+    """Restore every persistable and return the checkpoint's step.
+
+    Consistency under a live writer: the manifest and the STEP file are
+    two files, so a reader racing a concurrent ``save_checkpoint`` or
+    :func:`rollback_checkpoint` could naively pair one save's params
+    with another's step.  The step IS the save-generation clock
+    (:func:`step_generation`), which every var record carries — so this
+    loads against one pinned manifest read, then accepts the result
+    only when ``step_generation(STEP)`` equals that manifest's newest
+    generation, retrying on a mismatch (a torn window mid-rename).
+    Checkpoints saved without ``step`` (no STEP file, or legacy
+    manifests without generation counters) load exactly as before —
+    there is nothing to bind."""
+    last_err = None
+    for _attempt in range(8):
+        manifest = _read_manifest(dirname)
+        try:
+            n = load_persistables(executor, dirname, main_program,
+                                  scope=scope, manifest=manifest)
+        except OSError as e:
+            # a concurrent writer swept this manifest's generation files
+            # mid-read: re-read and retry against the newer manifest.
+            # (ValueError — program mismatch, format gate, torn
+            # multi-host coverage — propagates loudly, as before.)
+            last_err = e
+            time.sleep(0.005)
+            continue
+        if n == 0:
+            last_err = ValueError(
+                "checkpoint %s restored nothing — no persistable var of "
+                "the program matches a saved name (was the program "
+                "rebuilt with different auto-generated names? build it "
+                "under reset_unique_name_guard() for stable names)"
+                % dirname)
+            if not os.path.exists(os.path.join(dirname, _MANIFEST)):
+                raise last_err  # no manifest at all: not a race
+            time.sleep(0.005)
+            continue
+        step = _read_step_file(dirname)
+        gen = _newest_generation(manifest)
+        if step is None or gen == 0:
+            return step  # nothing to bind (legacy / step-less save)
+        if step_generation(step) == gen:
+            return step
+        last_err = RuntimeError(
+            "checkpoint %s is mid-update: STEP %d does not match the "
+            "manifest generation %d" % (dirname, step, gen))
+        time.sleep(0.005)
+    if isinstance(last_err, ValueError):
+        raise last_err  # steady-state miss, not a race: original error
+    raise RuntimeError(
+        "checkpoint %s kept changing under the reader — could not "
+        "observe a consistent (params, step) pair in 8 attempts "
+        "(last: %s)" % (dirname, last_err))
+
+
+def rollback_checkpoint(dirname):
+    """Restore the archived previous checkpoint in place: rename the
+    ``__manifest__.json.prev`` / ``STEP.prev`` pair (written by
+    :func:`_write_manifest` / :func:`write_step_file` when a save
+    supersedes a checkpoint) back over the live files.  The archived
+    generation's data files are still on disk — the generation GC
+    never sweeps manifest-referenced generations — so the result is the
+    complete previous (params, step) checkpoint.  Returns the restored
+    step (None when the archive predates step tracking).  Raises when
+    there is no archive to roll back to.  Concurrent readers using
+    :func:`load_checkpoint` observe either the old or the new pair,
+    never a mix (the generation binding there retries the torn
+    window)."""
+    man = os.path.join(dirname, _MANIFEST)
+    prev = man + '.prev'
+    if not os.path.exists(prev):
+        raise ValueError(
+            "no %s.prev archive in %s — nothing to roll back to (only "
+            "a save that SUPERSEDED a checkpoint leaves an archive)"
+            % (_MANIFEST, dirname))
+    # manifest first, STEP second — the same order save_checkpoint
+    # writes them, so load_checkpoint's gen<->step binding sees the
+    # same torn-window shapes either way and retries through both
+    os.replace(prev, man)
+    step_prev = os.path.join(dirname, 'STEP.prev')
+    step_live = os.path.join(dirname, 'STEP')
+    if os.path.exists(step_prev):
+        os.replace(step_prev, step_live)
+    else:
+        # no archived step: the checkpoint being restored predates
+        # step tracking (or was saved step-less), so any live STEP
+        # belongs to the save we just rolled back — leaving it would
+        # pair the restored params with the superseded step, the exact
+        # desync this protocol exists to prevent
+        try:
+            os.remove(step_live)
+        except OSError:
+            pass
+    return _read_step_file(dirname)
+
+
+# -- serving version directories (inference/fleet.py) ---------------------
+_BUCKET_RE = re.compile(r'^bucket_(\d+)\.stablehlo$')
+
+
+def bucket_artifacts(dirname):
+    """{bucket_size: path} for the ``export_bucketed`` artifacts in a
+    directory (``bucket_<N>.stablehlo``) — the on-disk shape of one
+    servable model version.  Empty dict when the directory holds none
+    (callers use that as the is-this-a-version-dir predicate)."""
+    out = {}
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return out
+    for fname in entries:
+        m = _BUCKET_RE.match(fname)
+        if m:
+            out[int(m.group(1))] = os.path.join(dirname, fname)
+    return out
+
+
+def resolve_version_dir(path, version=None):
+    """Resolve a servable version directory, TF-Serving style.
+
+    ``path`` either IS an ``export_bucketed`` artifact directory, or a
+    base directory of versioned subdirectories (``base/1``, ``base/2``,
+    ... — numeric names are versions; the HIGHEST number is the newest).
+    Returns ``(version_dir, version_name)``:
+
+    - ``version`` given: that subdirectory, loudly checked.
+    - ``path`` holds bucket artifacts directly: ``path`` itself, named
+      by its basename.
+    - otherwise: the numerically-highest subdirectory that holds bucket
+      artifacts (non-numeric subdirs are considered last,
+      lexicographically, so a ``canary/`` next to ``1..N`` never wins
+      by accident).
+    """
+    if version is not None:
+        d = os.path.join(path, str(version))
+        if not bucket_artifacts(d):
+            raise ValueError(
+                "version %r under %s has no bucket_<N>.stablehlo "
+                "artifacts (export_bucketed writes them)"
+                % (version, path))
+        return d, str(version)
+    if bucket_artifacts(path):
+        name = os.path.basename(os.path.abspath(path).rstrip(os.sep))
+        return path, name
+    try:
+        entries = sorted(os.listdir(path))
+    except OSError:
+        raise ValueError("version path %s is not a directory" % (path,))
+    candidates = []
+    for e in entries:
+        d = os.path.join(path, e)
+        if os.path.isdir(d) and bucket_artifacts(d):
+            candidates.append(e)
+    if not candidates:
+        raise ValueError(
+            "%s holds neither bucket_<N>.stablehlo artifacts nor "
+            "versioned subdirectories containing them — point the "
+            "fleet at an export_bucketed output dir or a base dir of "
+            "numbered versions" % (path,))
+    # non-digit names sort first (they only win when no numbered
+    # version exists, and then lexicographically-last of them does)
+    candidates.sort(key=lambda e: (1, int(e)) if e.isdigit()
+                    else (0, e))
+    best = candidates[-1]
+    return os.path.join(path, best), best
+
+
+# -- .prev-protocol JSON records (fleet deploy/rollback state) ------------
+def write_rollback_json(path, obj):
+    """Write a small JSON state file under the STEP-file ``.prev``
+    protocol: when the on-disk content CHANGES, the superseded file is
+    archived as ``<path>.prev`` first (hardlink or copy —
+    :func:`_archive_prev`), then the new content lands via tmp+rename,
+    so a crash mid-write never tears the record and a rollback always
+    has the superseded state to return to.  Re-writing identical
+    content leaves the archive alone (mirrors write_step_file)."""
+    changed = True
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                changed = json.load(f) != obj
+        except (OSError, ValueError):
+            changed = True  # unreadable counts as a change
+        if changed:
+            _archive_prev(path)
+    tmp = '%s.tmp.%d' % (path, os.getpid())
+    with open(tmp, 'w') as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def read_rollback_json(path, prev=False):
+    """Read a :func:`write_rollback_json` record; ``prev=True`` reads
+    the ``.prev`` archive (the state the newest write superseded).
+    Returns None when the requested file does not exist."""
+    p = path + '.prev' if prev else path
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except OSError:
+        return None

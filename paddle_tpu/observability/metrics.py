@@ -181,15 +181,31 @@ class Counter(_Metric):
 
 
 class _GaugeChild(object):
-    __slots__ = ('_lock', '_value')
+    __slots__ = ('_lock', '_value', '_fn')
 
     def __init__(self, lock):
         self._lock = lock
         self._value = 0.0
+        self._fn = None
 
     def set(self, value):
         with self._lock:
             self._value = float(value)
+
+    def set_function(self, fn):
+        """Make this gauge PULL its value: ``fn()`` is called at every
+        read (exposition scrape, snapshot, ``.value``) instead of the
+        stored level.  The natural fit for values that are already live
+        state somewhere else — a fleet's aggregate queue depth, a pool
+        size — where push-updating on every transition would scatter
+        bookkeeping across the owner's code paths.  ``fn`` must be fast
+        and thread-safe; it is invoked OUTSIDE the metric lock (it may
+        take the owner's own locks without deadlocking a concurrent
+        scrape), and any exception falls back to the last pushed value
+        rather than failing the scrape.  ``set_function(None)`` reverts
+        to push mode."""
+        with self._lock:
+            self._fn = fn
 
     def inc(self, amount=1):
         with self._lock:
@@ -202,11 +218,20 @@ class _GaugeChild(object):
     @property
     def value(self):
         with self._lock:
-            return self._value
+            fn = self._fn
+            v = self._value
+        if fn is None:
+            return v
+        try:
+            return float(fn())
+        except Exception:
+            return v
 
 
 class Gauge(_Metric):
-    """Instantaneous level (queue depth, batches in flight)."""
+    """Instantaneous level (queue depth, batches in flight).  Children
+    are push-style (``set``/``inc``/``dec``) by default; ``set_function``
+    turns one into a pull-style callback gauge read at scrape time."""
     kind = 'gauge'
 
     def _make_child(self, key):
@@ -214,6 +239,9 @@ class Gauge(_Metric):
 
     def set(self, value):
         self._default().set(value)
+
+    def set_function(self, fn):
+        self._default().set_function(fn)
 
     def inc(self, amount=1):
         self._default().inc(amount)

@@ -1,0 +1,37 @@
+"""Flag-documentation consistency (tools/check_flags_doc.py in tier-1).
+
+Every registered ``PADDLE_TPU_*`` flag must be documented in README.md
+and carried by ``FLAGS.help()`` with a non-empty help string — the same
+import-the-tool wiring test_amp.py uses for check_amp_lists.
+"""
+import importlib.util
+import os
+
+
+def _load_tool():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'check_flags_doc.py')
+    spec = importlib.util.spec_from_file_location('check_flags_doc',
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_flags_doc_tool():
+    mod = _load_tool()
+    errors = mod.check()
+    assert errors == [], '\n'.join(errors)
+
+
+def test_flags_definitions_surface():
+    """The definitions() accessor the checker audits through exposes
+    every declared flag with its default and help string."""
+    from paddle_tpu.flags import FLAGS
+    defs = FLAGS.definitions()
+    assert 'fleet_replicas' in defs
+    default, help_str = defs['fleet_replicas']
+    assert default == 2
+    assert 'ServingFleet' in help_str
+    # declared() and definitions() agree on the flag set
+    assert set(defs) == set(FLAGS.declared())

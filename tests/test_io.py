@@ -629,3 +629,134 @@ def test_downgrade_resave_archives_consistent_prev_pair(tmp_path):
     io.save_checkpoint(exe, ckpt, main, step=11)
     with open(os.path.join(ckpt, 'STEP.prev')) as f:
         assert int(f.read()) == 10
+
+
+def test_rollback_checkpoint_helper(tmp_path):
+    """io.rollback_checkpoint renames the archived .prev pair back in
+    one call and returns the restored step (the manual os.replace
+    dance the earlier rollback tests spell out, as API)."""
+    import pytest
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    ckpt = str(tmp_path / 'rb')
+
+    with pytest.raises(ValueError, match='nothing to roll back'):
+        io.rollback_checkpoint(ckpt)
+
+    _train_steps(exe, main, loss, 2)
+    io.save_checkpoint(exe, ckpt, main, step=1)
+    at_1 = {v.name: np.asarray(scope.find_var(v.name)).copy()
+            for v in main.list_vars()
+            if v.persistable and scope.find_var(v.name) is not None}
+    _train_steps(exe, main, loss, 2, seed=5)
+    io.save_checkpoint(exe, ckpt, main, step=2)
+
+    assert io.rollback_checkpoint(ckpt) == 1
+    for name, val in at_1.items():
+        scope.set(name, np.zeros_like(val))
+    assert io.load_checkpoint(exe, ckpt, main) == 1
+    for name, val in at_1.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)), val, err_msg=name)
+    # the archive was consumed by the rename: a second rollback has
+    # nothing to return to
+    with pytest.raises(ValueError, match='nothing to roll back'):
+        io.rollback_checkpoint(ckpt)
+
+
+def test_checkpoint_rollback_under_live_reader(tmp_path):
+    """The fleet-boundary regression for the PR-4 downgrade round-trip:
+    a reader calling load_checkpoint while a concurrent deploy()-style
+    writer re-saves and rolls back must ALWAYS observe a consistent
+    (params, step) pair — params from the very save that wrote that
+    step — never a new manifest paired with an old STEP or vice versa.
+    The binding is the save-generation clock: load_checkpoint pins one
+    manifest read and accepts only step_generation(STEP) == its newest
+    generation, retrying through torn rename windows."""
+    import threading
+
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ckpt = str(tmp_path / 'live')
+
+    # the writer owns a private scope whose first parameter encodes the
+    # step number — so a reader can verify params<->step consistency
+    # from the loaded values alone
+    w_name = next(p.name for p in main.list_vars() if io.is_parameter(p))
+    w_shape = np.asarray(fluid.global_scope().find_var(w_name)).shape
+    wscope = fluid.Scope()
+    for v in main.list_vars():
+        if v.persistable:
+            val = fluid.global_scope().find_var(v.name)
+            if val is not None:
+                wscope.set(v.name, np.asarray(val).copy())
+
+    def write_at(step):
+        wscope.set(w_name, np.full(w_shape, float(step), np.float32))
+        io.save_checkpoint(exe, ckpt, main, step=step, scope=wscope)
+
+    write_at(1)  # the reader always has something to load
+
+    stop = threading.Event()
+    inconsistent, read_errors, good = [], [], [0]
+
+    def reader():
+        while not stop.is_set():
+            rscope = fluid.Scope()
+            try:
+                step = io.load_checkpoint(exe, ckpt, main, scope=rscope)
+            except RuntimeError as e:
+                # "kept changing under the reader" is loud, not wrong —
+                # but it should be rare enough to never exhaust a run
+                read_errors.append(e)
+                continue
+            w = np.asarray(rscope.find_var(w_name))
+            if not np.all(w == float(step)):
+                inconsistent.append(
+                    (step, float(w.ravel()[0])))  # pragma: no cover
+            good[0] += 1
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        step = 1
+        for i in range(12):
+            step += 1
+            write_at(step)
+            if i % 3 == 2:
+                # deploy()-style downgrade: roll back to the archived
+                # previous checkpoint, then keep saving past it
+                step = io.rollback_checkpoint(ckpt)
+                assert step is not None
+    finally:
+        stop.set()
+        t.join(30.0)
+    assert not t.is_alive()
+    assert inconsistent == [], (
+        "reader observed params from one save paired with another "
+        "save's step: %s" % inconsistent[:5])
+    assert good[0] > 0, "reader never completed a load"
+    assert len(read_errors) == 0 or good[0] > len(read_errors)
+
+
+def test_rollback_to_stepless_checkpoint_clears_step(tmp_path):
+    """Rolling back to a checkpoint that predates step tracking must
+    not leave the superseded save's STEP behind: the restored pair is
+    (prev params, no step), never (prev params, new step)."""
+    main, startup, pred, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ckpt = str(tmp_path / 'stepless')
+
+    io.save_checkpoint(exe, ckpt, main)           # step-less save
+    _train_steps(exe, main, loss, 2)
+    io.save_checkpoint(exe, ckpt, main, step=7)   # supersedes it
+    assert os.path.exists(os.path.join(ckpt, 'STEP'))
+
+    assert io.rollback_checkpoint(ckpt) is None
+    assert not os.path.exists(os.path.join(ckpt, 'STEP')), \
+        "STEP=7 survived a rollback to a step-less checkpoint"
+    assert io.load_checkpoint(exe, ckpt, main) is None

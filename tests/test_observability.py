@@ -352,7 +352,12 @@ def test_batching_stats_backward_compat_shape():
             'queue_depth', 'in_flight_batches', 'requests_submitted',
             'requests_completed', 'batches', 'mean_batch_occupancy',
             'mean_bucket_fill', 'compiles', 'compiles_after_warmup',
-            'p50_latency_ms', 'p99_latency_ms', 'buckets'}
+            'p50_latency_ms', 'p99_latency_ms', 'buckets',
+            # additive (serving-fleet PR): the latency split the fleet
+            # dispatcher and bench share — every original key above is
+            # untouched
+            'queue_wait_p50_ms', 'queue_wait_p99_ms',
+            'compute_p50_ms', 'compute_p99_ms', 'per_bucket'}
         for k in ('requests_submitted', 'requests_completed', 'batches',
                   'compiles', 'compiles_after_warmup'):
             assert isinstance(st[k], int), k

@@ -1,0 +1,81 @@
+"""Static consistency check for the env-var flag documentation.
+
+Every flag registered in ``paddle_tpu/flags.py`` must appear (by its
+full ``PADDLE_TPU_<NAME>`` env-var spelling) in README.md's
+configuration docs AND in the ``python -m paddle_tpu.flags`` help
+output, with a non-empty help string.  Catches the drift mode where a
+PR adds a knob but never documents it — the knob then exists only for
+whoever read the diff.
+
+Runs standalone (``python tools/check_flags_doc.py``, exit 1 on
+failure) and in tier-1 via tests/test_flags_doc.py, which imports
+``check()`` so CI pays no extra interpreter start (the same wiring as
+tools/check_amp_lists.py).
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _pristine_flags():
+    """A fresh, private instance of paddle_tpu/flags.py — the audit
+    must see exactly the flags the module DECLARES, not whatever a
+    long-lived process (or an earlier test) DEFINE_*'d into the global
+    registry at runtime."""
+    import importlib.util
+    path = os.path.join(_REPO, 'paddle_tpu', 'flags.py')
+    spec = importlib.util.spec_from_file_location(
+        '_check_flags_doc_audit', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.FLAGS
+
+
+def check():
+    """Returns a list of human-readable error strings (empty = OK)."""
+    FLAGS = _pristine_flags()
+
+    errors = []
+    readme_path = os.path.join(_REPO, 'README.md')
+    try:
+        with open(readme_path) as f:
+            readme = f.read()
+    except OSError as e:
+        return ["cannot read README.md: %s" % e]
+    help_text = FLAGS.help()
+
+    defs = FLAGS.definitions()
+    if not defs:
+        return ["flags registry is empty — import order bug?"]
+    for name, (_default, help_str) in sorted(defs.items()):
+        env = 'PADDLE_TPU_' + name.upper()
+        if env not in readme:
+            errors.append(
+                "%s is not documented in README.md (add it to the "
+                "configuration table)" % env)
+        if env not in help_text:
+            errors.append(
+                "%s is missing from FLAGS.help() output" % env)
+        if not (help_str or '').strip():
+            errors.append(
+                "%s was declared with an empty help string — "
+                "`python -m paddle_tpu.flags` would print nothing "
+                "useful for it" % env)
+    return errors
+
+
+def main():
+    errors = check()
+    for e in errors:
+        print("check_flags_doc: %s" % e, file=sys.stderr)
+    if errors:
+        return 1
+    print("check_flags_doc: OK (%d flags documented in README and "
+          "--help)" % len(_pristine_flags().definitions()))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
