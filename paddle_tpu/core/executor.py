@@ -146,6 +146,12 @@ class _ExecutorMetrics(object):
             'paddle_tpu_executor_feed_prefetched_bytes_total',
             'bytes staged by the device-prefetch pipeline while a '
             'previous chunk was executing').child()
+        self.ir_verify_failures = r.counter(
+            'paddle_tpu_ir_verify_failures_total',
+            'plan builds rejected by the static IR verifier '
+            '(PADDLE_TPU_VERIFY_IR, transpiler/verify.py) — each one '
+            'is a pass bug or a malformed program caught before '
+            'tracing').child()
 
 
 _exec_metrics = None
@@ -209,44 +215,15 @@ def _quiet_unused_donation(feed_arrays=None):
                                w.lineno)
 
 
-def _sparse_apply_mode():
-    """Resolved sparse-apply lowering for a plan build (re-read every
-    build, like the graph-opt level, so PADDLE_TPU_SPARSE_APPLY flips
-    take effect on the next plan instead of silently serving a stale
-    trace)."""
-    from ..ops.pallas.table_update import sparse_apply_mode
-    return sparse_apply_mode()
-
-
-def _dense_apply_mode():
-    """Resolved dense-apply lowering for a plan build
-    (PADDLE_TPU_DENSE_APPLY; same re-read-per-build / plan-cache-key
-    contract as the sparse mode — the pallas/xla choice is baked into
-    the traced optimizer ops)."""
-    from ..ops.pallas.dense_update import dense_apply_mode
-    return dense_apply_mode()
-
-
-def _amp_plan_key():
-    """Resolved AMP mode (+ loss-scale knobs) for a plan build — re-read
-    every build like the graph-opt level, and part of every plan cache
-    key so a PADDLE_TPU_AMP flip is never served a stale-precision
-    trace.  None when AMP is off."""
-    from ..transpiler.amp import plan_key_component
-    return plan_key_component()
-
-
-def _graph_opt_level(program):
-    """Effective graph-opt level for a plan build: the
-    PADDLE_TPU_GRAPH_OPT_LEVEL flag (re-read on every build, so flips —
-    including after reset_cache() — take effect without a restart),
-    floored at 1 when memory_optimize()/release_memory() requested the
-    pipeline for this program."""
-    from ..transpiler.passes import _resolve_level
-    level = _resolve_level(None)
-    if getattr(program, '_graph_opt_requested', False):
-        level = max(level, 1)
-    return level
+def _pass_plan_key(program):
+    """The composite pass-configuration component of every plan cache
+    key — graph-opt level (with the memory_optimize floor), AMP mode
+    (+ loss-scale knobs), verify mode, and the sparse/dense apply
+    lowerings, all re-read per build so a flag flip is never served a
+    stale trace.  ONE code path (transpiler/pass_manager.plan_key)
+    feeds both the run and run_steps keys."""
+    from ..transpiler import pass_manager
+    return pass_manager.plan_key(program)
 
 
 class ExecutionContext(object):
@@ -905,22 +882,17 @@ class Executor(object):
         # embeds that mesh's shard_map in the compiled step.  Scope
         # identity is its monotonic _uid, never id(): ids recycle after
         # gc and would alias a fresh scope's plans with a dead one's.
-        # The graph-opt level participates too: a flag flip must not be
-        # served a plan traced at the old level.  Same for the sparse-
-        # and dense-apply lowerings (PADDLE_TPU_SPARSE_APPLY /
-        # PADDLE_TPU_DENSE_APPLY): the pallas/xla choice is baked into
-        # the traced optimizer ops.
-        # ... and the AMP mode (PADDLE_TPU_AMP): a bf16-rewritten trace
-        # must never serve an f32 request or vice versa.
+        # The pass configuration participates as ONE composite component
+        # (pass_manager.plan_key): graph-opt level, AMP mode, verify
+        # mode, sparse/dense apply lowerings — a flip of any must not be
+        # served a plan built under the old configuration.
         # feed_donate keys the donation variant: a plan jitted with the
         # feed argument donated must never serve a call whose feed
         # buffers the caller still owns.
-        opt_level = _graph_opt_level(program)
-        amp_key = _amp_plan_key()
+        pm_key = _pass_plan_key(program)
         key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names,
-               scope._uid, mesh, opt_level, _sparse_apply_mode(),
-               _dense_apply_mode(), amp_key, feed_donate)
+               scope._uid, mesh, pm_key, feed_donate)
         if use_cache and key in self._cache:
             self._plan_fresh = False
             # keep the report describing THIS plan, not whichever plan
@@ -949,70 +921,53 @@ class Executor(object):
                     "fetch var %r is not produced by any op in the program "
                     "and is not fed" % n)
 
-        prog = program
-        if opt_level > 0:
-            # rewrite a COPY of the block before tracing: dead-op
-            # elimination, constant folding, CSE (transpiler/passes.py).
-            # A pipeline failure must never take execution down with it
-            # — fall back to the unoptimized program.
-            from ..transpiler import passes
-            try:
-                prog, opt_report = passes.run_pipeline(
-                    program, fetch_names=fetch_names,
-                    feed_names=tuple(sorted(feed_arrays)),
-                    level=opt_level)
-            except Exception:
-                import logging
-                logging.getLogger(__name__).warning(
-                    "graph-opt pipeline failed; tracing the unoptimized "
-                    "program", exc_info=True)
-                prog, opt_report = program, None
-            self.last_graph_opt_report = opt_report
-            if opt_report is not None and _obs.enabled():
+        # The managed pass pipeline (transpiler/pass_manager.py): graph
+        # opt -> AMP -> donation analysis over a COPY of the block,
+        # statically verified per PADDLE_TPU_VERIFY_IR.  A crashing pass
+        # is skipped inside the manager (per-pass fallback, reported in
+        # last_graph_opt_report['passes']); a manager-level failure
+        # falls back to tracing the unrewritten program; a VERIFIER
+        # rejection propagates — a program the checker proves broken
+        # must not be traced into a worse error downstream.
+        from ..transpiler import pass_manager
+        from ..transpiler.verify import IRVerificationError
+        prog, report = program, None
+        try:
+            prog, report = pass_manager.run_pipeline(
+                program, fetch_names=fetch_names,
+                feed_names=tuple(sorted(feed_arrays)))
+        except IRVerificationError:
+            if _obs.enabled():
+                _em().ir_verify_failures.inc()
+            raise
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "pass pipeline failed; tracing the unrewritten program",
+                exc_info=True)
+        if report is not None and report['level'] <= 0 and \
+                'amp' not in report:
+            report = None  # nothing rewrote: legacy bypass contract
+        self.last_graph_opt_report = report
+        if report is not None:
+            if report['ops_before'] is not None and _obs.enabled():
                 em = _em()
+                # count what the graph-opt passes actually removed, not
+                # the before/after op delta — AMP weaves casts in after
+                # the eliminations and would mask them
+                em.graph_opt_seconds.observe(sum(
+                    e['wall_s'] for e in report['passes']
+                    if e['name'] != 'amp'))
                 em.graph_opt_ops_eliminated.inc(
-                    max(0, (opt_report['ops_before'] or 0) -
-                        (opt_report['ops_after'] or 0)))
-                em.graph_opt_seconds.observe(opt_report['pass_wall_s'])
-        else:
-            self.last_graph_opt_report = None
-        if amp_key is not None:
-            # AMP cast-insertion pass (transpiler/amp.py), after the
-            # graph-opt pipeline so casts weave into the already-pruned
-            # block.  Same failure contract as the pipeline: a pass bug
-            # falls back to the unrewritten program with a warning.
-            from ..transpiler import amp as _amp
-            try:
-                # apply_amp deep-copies internally, so a weaver failure
-                # can never leave `prog` (the fallback) half-rewritten
-                amp_prog, amp_report = _amp.apply_amp(prog)
-            except Exception:
-                import logging
-                logging.getLogger(__name__).warning(
-                    "AMP pass failed; tracing at full precision",
-                    exc_info=True)
-                amp_prog, amp_report = prog, None
+                    max(0, sum(report['eliminated'].values())))
+            amp_report = report.get('amp')
             if amp_report is not None:
-                prog = amp_prog
                 # seed the dynamic-loss-scale state (f16 mode) so the
                 # state analysis below sees live values — the user never
                 # runs a startup program for pass-created vars
                 for n, v in amp_report['state_defaults'].items():
                     if not scope.has(n):
                         scope.set(n, jnp.asarray(v))
-                rep = dict(self.last_graph_opt_report or
-                           {'level': opt_level, 'ops_before': None,
-                            'ops_after': None, 'eliminated': {},
-                            'pass_wall_s': 0.0})
-                rep['amp'] = amp_report
-                if 'donation' in rep:
-                    # re-derive over the rewritten block: lowered
-                    # intermediates are declared bf16/f16 now, so the
-                    # bytes estimate reflects the halved activations
-                    from ..transpiler.passes import analyze_donation
-                    rep['donation'] = analyze_donation(
-                        prog, fetch_names, tuple(sorted(feed_arrays)))
-                self.last_graph_opt_report = rep
                 # the rewrite can add persistable state: re-derive the
                 # rw/ro/out sets from the program that will actually
                 # trace (the pre-rewrite sets only keyed the cache)
@@ -1183,10 +1138,11 @@ class Executor(object):
                     ro_names, mesh, raw_fn, k, stacked):
         """Get-or-build the jitted K-step scan plan for one scan length.
 
-        The graph-opt level (and the sparse/dense apply modes and AMP
-        key) key the multi plan too: the scan closes over raw_fn, which
-        traces the (un)optimized program — a flag flip must not be
-        served a scan over the old one.  The stacked feed argument (xs)
+        The composite pass-configuration key (_pass_plan_key — the same
+        single code path the run() key uses) keys the multi plan too:
+        the scan closes over raw_fn, which traces the (un)rewritten
+        program — a flag flip must not be served a scan over the old
+        one.  The stacked feed argument (xs)
         is donated along with the state: run_steps always builds the
         stack itself from host copies, so the buffer is executor-owned
         and dead once the scan consumed it — XLA gets the whole stack
@@ -1195,9 +1151,7 @@ class Executor(object):
                 fetch_names,
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
                       for n in sorted(feed0)), scope._uid,
-                rw_names, ro_names, mesh, _graph_opt_level(program),
-                _sparse_apply_mode(), _dense_apply_mode(),
-                _amp_plan_key())
+                rw_names, ro_names, mesh, _pass_plan_key(program))
         multi = self._cache.get(mkey)
         fresh = multi is None
         if fresh:
@@ -1472,9 +1426,10 @@ class Executor(object):
         persistent-compile-cache dir (PADDLE_TPU_COMPILATION_CACHE_DIR)
         is re-applied, and the next plan build re-reads
         PADDLE_TPU_GRAPH_OPT_LEVEL, PADDLE_TPU_SPARSE_APPLY,
-        PADDLE_TPU_DENSE_APPLY, and PADDLE_TPU_AMP (each is part of
-        every plan key, so flips invalidate naturally — this just frees
-        the old plans).  PADDLE_TPU_DEVICE_PREFETCH is re-read on every
+        PADDLE_TPU_DENSE_APPLY, PADDLE_TPU_AMP, and
+        PADDLE_TPU_VERIFY_IR (all folded into the composite
+        pass-configuration component of every plan key, so flips
+        invalidate naturally — this just frees the old plans).  PADDLE_TPU_DEVICE_PREFETCH is re-read on every
         run_steps call and its chunking keys the scan plans by length,
         so it needs no special handling here either."""
         self.close()

@@ -27,11 +27,9 @@ class _InferCtx(object):
         return jax.random.PRNGKey(0)
 
 
-def infer_outputs(op_type, input_specs, attrs, out_slots):
-    """input_specs: {slot: [(shape, dtype) or None]}.  Returns
-    {slot: [(shape, dtype)]} with -1 restored where the sentinel appears.
-    """
-    impl = get_op_impl(op_type)
+def _encode_ins(input_specs):
+    """{slot: [(shape, dtype) | None]} -> ({slot: [ShapeDtypeStruct]},
+    had_unknown) with -1 dims mapped to the batch sentinel."""
     had_unknown = False
     ins = {}
     for slot, specs in input_specs.items():
@@ -55,18 +53,19 @@ def infer_outputs(op_type, input_specs, attrs, out_slots):
                 np_dtype = np.float32
             vals.append(jax.ShapeDtypeStruct(tuple(shape2), np_dtype))
         ins[slot] = vals
+    return ins, had_unknown
 
-    ctx = _InferCtx()
 
-    def f(ins_):
-        return impl.compute(ctx, ins_, attrs)
-
-    outs = jax.eval_shape(f, ins)
+def _decode_outs(outs, out_slots, had_unknown):
     result = {}
     for slot in out_slots:
         specs = []
         for o in (outs or {}).get(slot, []):
-            if o is None:
+            if o is None or not (hasattr(o, 'shape')
+                                 and hasattr(o, 'dtype')):
+                # non-tensor abstract outputs (SelectedRows,
+                # LoDTensorArray handles) carry no (shape, dtype)
+                # verdict — report "unknown", don't fail the whole op
                 specs.append(None)
                 continue
             shape = tuple(-1 if (had_unknown and d == _BATCH_SENTINEL) else d
@@ -74,3 +73,168 @@ def infer_outputs(op_type, input_specs, attrs, out_slots):
             specs.append((shape, datatypes.convert_dtype(o.dtype)))
         result[slot] = specs
     return result
+
+
+def infer_outputs(op_type, input_specs, attrs, out_slots):
+    """input_specs: {slot: [(shape, dtype) or None]}.  Returns
+    {slot: [(shape, dtype)]} with -1 restored where the sentinel appears.
+    """
+    impl = get_op_impl(op_type)
+    ins, had_unknown = _encode_ins(input_specs)
+    ctx = _InferCtx()
+
+    def f(ins_):
+        return impl.compute(ctx, ins_, attrs)
+
+    outs = jax.eval_shape(f, ins)
+    return _decode_outs(outs, out_slots, had_unknown)
+
+
+# ---------------------------------------------------------------------------
+# Memoized re-inference (the IR verifier's entry point).
+#
+# The verifier re-infers every checkable op of every plan build; one
+# eval_shape is a fresh jax trace, so identical (op, input specs, attrs)
+# triples — CSE'd programs, run/run_steps plan pairs, repeated builds —
+# must share one trace.  The cache is process-global and bounded: entries
+# key on hashable spec/attr tuples, odd attr values fall back to uncached.
+
+_INFER_CACHE = {}
+_INFER_CACHE_CAP = 4096
+_FAILED = object()  # negative-cache sentinel: this triple cannot infer
+
+
+class InferenceFailedError(RuntimeError):
+    """Raised on a negative-cache hit: this exact (op, specs, attrs)
+    triple already failed abstract evaluation once."""
+
+
+class _Uncacheable(Exception):
+    pass
+
+
+def _hashable(v):
+    if isinstance(v, np.ndarray):
+        return ('nd', str(v.dtype), v.shape, v.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple((k, _hashable(v[k])) for k in sorted(v))
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return v
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    raise _Uncacheable(type(v).__name__)
+
+
+def infer_outputs_cached(op_type, input_specs, attrs, out_slots):
+    """infer_outputs with a process-global memo.  Raises whatever
+    eval_shape raises — callers decide whether that is an error."""
+    try:
+        key = _cache_key(op_type, input_specs, attrs, out_slots)
+    except (_Uncacheable, TypeError):
+        return infer_outputs(op_type, input_specs, attrs, out_slots)
+    hit = _INFER_CACHE.get(key)
+    if hit is _FAILED:
+        # negative cache: un-evaluable triples (e.g. SelectedRows-only
+        # ops fed dense specs) would otherwise re-pay a failing jax
+        # trace on every verifier run
+        raise InferenceFailedError(op_type)
+    if hit is not None:
+        return hit
+    if len(_INFER_CACHE) >= _INFER_CACHE_CAP:
+        _INFER_CACHE.clear()  # simple bound; refill is cheap
+    try:
+        result = infer_outputs(op_type, input_specs, attrs, out_slots)
+    except Exception:
+        _INFER_CACHE[key] = _FAILED
+        raise
+    _INFER_CACHE[key] = result
+    return result
+
+
+# attrs that never affect the computed shapes/dtypes: pass bookkeeping
+# (op_seq position stamps, role tags, AMP gating) — excluding them from
+# the key lets a build-time inference (layer_helper, pre-stamp) serve
+# the verifier's post-pass lookup of the same op
+_NON_SEMANTIC_ATTRS = frozenset({'op_seq', 'op_role', 'amp_gate_var'})
+
+
+def _cache_key(op_type, input_specs, attrs, out_slots):
+    return (op_type,
+            tuple((slot,
+                   tuple(None if s is None else (tuple(s[0]), str(s[1]))
+                         for s in specs))
+                  for slot, specs in sorted(input_specs.items())),
+            tuple((k, _hashable(attrs[k])) for k in sorted(attrs)
+                  if k not in _NON_SEMANTIC_ATTRS),
+            tuple(out_slots))
+
+
+def _eval_batch(tasks):
+    """Abstractly evaluate many (impl, ins, attrs) triples in ONE
+    eval_shape trace — per-call pjit overhead (~2 ms) is paid once for
+    the whole batch instead of once per op."""
+    ctx = _InferCtx()
+
+    def f(all_ins):
+        return [impl.compute(ctx, ins_, attrs)
+                for (impl, _ins, attrs), ins_ in zip(tasks, all_ins)]
+
+    return jax.eval_shape(f, [ins for _impl, ins, _attrs in tasks])
+
+
+def prime_infer_cache(requests):
+    """Warm the memo for many (op_type, input_specs, attrs, out_slots)
+    requests at once — the IR verifier's cold-start path.  Uncached
+    requests are abstractly evaluated in one batched trace; a failing
+    batch bisects until the individually un-evaluable requests are
+    isolated and negative-cached.  Requests that cannot be keyed are
+    skipped (the per-op path handles them uncached)."""
+    pending = []  # (key, impl, ins, attrs, out_slots, had_unknown)
+    seen = set()
+    for op_type, input_specs, attrs, out_slots in requests:
+        try:
+            key = _cache_key(op_type, input_specs, attrs, out_slots)
+        except (_Uncacheable, TypeError):
+            continue
+        if key in _INFER_CACHE or key in seen:
+            continue
+        seen.add(key)
+        try:
+            impl = get_op_impl(op_type)
+            ins, had_unknown = _encode_ins(input_specs)
+        except Exception:
+            _INFER_CACHE[key] = _FAILED
+            continue
+        pending.append((key, impl, ins, attrs, tuple(out_slots),
+                        had_unknown))
+
+    def solve(chunk):
+        if not chunk:
+            return
+        try:
+            outs = _eval_batch([(impl, ins, attrs)
+                                for _k, impl, ins, attrs, _o, _u
+                                in chunk])
+        except Exception:
+            if len(chunk) == 1:
+                _INFER_CACHE[chunk[0][0]] = _FAILED
+                return
+            mid = len(chunk) // 2
+            solve(chunk[:mid])
+            solve(chunk[mid:])
+            return
+        for (key, _impl, _ins, _attrs, out_slots, had_unknown), o in \
+                zip(chunk, outs):
+            try:
+                _INFER_CACHE[key] = _decode_outs(o, out_slots,
+                                                 had_unknown)
+            except Exception:
+                # non-tensor abstract outputs (e.g. SelectedRows) have
+                # no (shape, dtype) reading — no verdict for this op
+                _INFER_CACHE[key] = _FAILED
+
+    if len(_INFER_CACHE) + len(pending) >= _INFER_CACHE_CAP:
+        _INFER_CACHE.clear()
+    solve(pending)

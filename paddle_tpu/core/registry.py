@@ -8,8 +8,19 @@ XLA owns device lowering).  Signature:
 
 where `ins` is {slot: [arrays]} and ctx is an ExecutionContext giving access
 to PRNG keys and the interpreter (for ops with sub-blocks).
+
+``op_signature()`` recovers each op's *declared-slot contract* statically —
+the reference's OpProto (op_proto_maker.h) rebuilt by AST introspection of
+the compute function instead of a hand-maintained proto: which input slots
+the function can read, which output slots it can produce, and which attrs
+it requires.  The IR verifier (transpiler/verify.py) checks every OpDesc
+against it, so a layer passing a slot the kernel never reads fails at plan
+build with an op-precise message instead of silently dropping the tensor.
 """
+import ast
 import collections
+import inspect
+import textwrap
 
 _OP_REGISTRY = {}
 _CALLED = set()  # op types fetched for execution (coverage meta-test)
@@ -134,6 +145,285 @@ def op_traits(type):
         return OpTraits(False, False, False, amp_class(type))
     return OpTraits(True, impl.stateful_rng, impl.needs_env,
                     amp_class(type))
+
+
+# ---------------------------------------------------------------------------
+# Static op signatures (OpProto parity, recovered by introspection).
+#
+# A signature dimension is *closed* when the AST walk accounted for every
+# use of the corresponding parameter (`ins` / `attrs` / the return value);
+# it is *open* when the function does something the walk cannot name (e.g.
+# iterates ins.items(), builds slot names dynamically, returns a dict
+# assembled elsewhere).  Open dimensions are simply not checkable — the
+# verifier skips them instead of guessing.
+
+OpSignature = collections.namedtuple('OpSignature', [
+    'in_slots',        # frozenset: input slot names the fn can read
+    'in_open',         # True -> in_slots is incomplete, don't enforce
+    'out_slots',       # frozenset: output slot names the fn can return
+    'out_open',        # True -> out_slots is incomplete, don't enforce
+    'attr_keys',       # frozenset: every attr key the fn reads
+    'required_attrs',  # frozenset: keys read unconditionally via attrs[k]
+])
+
+_OPEN_SIGNATURE = OpSignature(frozenset(), True, frozenset(), True,
+                              frozenset(), frozenset())
+_SIG_CACHE = {}
+
+# dict methods whose use keeps the slot set knowable (.get with a literal
+# key) vs. ones that make it open (whole-dict iteration/copy)
+_OPEN_DICT_METHODS = ('items', 'values', 'keys', 'pop', 'update', 'copy',
+                      'setdefault')
+
+
+class _SigVisitor(ast.NodeVisitor):
+    """Collect literal-keyed accesses of one dict-shaped parameter.
+
+    Tracks whether each access is control-flow-conditional (inside
+    If/IfExp/Try/loop bodies, boolop tails, or nested defs/lambdas) so
+    ``attrs['k']`` counts as *required* only when it runs on every call.
+    """
+
+    def __init__(self, param):
+        self.param = param
+        self.keys = set()
+        self.required = set()     # unconditional [k] subscripts
+        self.guarded = set()      # keys seen via .get()/`in` (optional)
+        self.open = False
+        self._covered = set()     # id()s of Name nodes already explained
+        self._cond = 0
+
+    # -- helpers -----------------------------------------------------------
+    def _is_param(self, node):
+        return isinstance(node, ast.Name) and node.id == self.param
+
+    def _const_str(self, node):
+        return node.value if (isinstance(node, ast.Constant)
+                              and isinstance(node.value, str)) else None
+
+    # -- conditional-context scaffolding -----------------------------------
+    def _visit_cond(self, node):
+        self._cond += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._cond -= 1
+
+    def visit_IfExp(self, node):
+        self.visit(node.test)
+        self._cond += 1
+        try:
+            self.visit(node.body)
+            self.visit(node.orelse)
+        finally:
+            self._cond -= 1
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        self._cond += 1
+        try:
+            for n in node.body + node.orelse:
+                self.visit(n)
+        finally:
+            self._cond -= 1
+
+    def visit_Try(self, node):
+        self._visit_cond(node)
+
+    def visit_While(self, node):
+        self._visit_cond(node)
+
+    def visit_For(self, node):
+        self._visit_cond(node)
+
+    def visit_BoolOp(self, node):
+        self.visit(node.values[0])
+        self._cond += 1
+        try:
+            for v in node.values[1:]:
+                self.visit(v)
+        finally:
+            self._cond -= 1
+
+    def visit_FunctionDef(self, node):
+        self._visit_cond(node)  # inner defs may never run
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._visit_cond(node)
+
+    # -- the accesses ------------------------------------------------------
+    def visit_Subscript(self, node):
+        if self._is_param(node.value):
+            self._covered.add(id(node.value))
+            key = self._const_str(node.slice)
+            if key is None:
+                self.open = True
+            else:
+                self.keys.add(key)
+                if self._cond == 0:
+                    self.required.add(key)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute) and self._is_param(func.value):
+            self._covered.add(id(func.value))
+            if func.attr == 'get':
+                key = (self._const_str(node.args[0])
+                       if node.args else None)
+                if key is None:
+                    self.open = True
+                else:
+                    self.keys.add(key)
+                    self.guarded.add(key)
+            elif func.attr in _OPEN_DICT_METHODS:
+                self.open = True
+        elif isinstance(func, ast.Name) and func.id == 'first' and \
+                any(self._is_param(a) for a in node.args):
+            # ops/common.py first(ins, 'X') — the dominant idiom
+            for a in node.args:
+                if self._is_param(a):
+                    self._covered.add(id(a))
+            key = next((self._const_str(a) for a in node.args
+                        if self._const_str(a) is not None), None)
+            if key is None:
+                self.open = True
+            else:
+                self.keys.add(key)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        # `'k' in attrs` proves the fn handles absence -> optional
+        if len(node.ops) == 1 and isinstance(node.ops[0],
+                                             (ast.In, ast.NotIn)) and \
+                self._is_param(node.comparators[0]):
+            self._covered.add(id(node.comparators[0]))
+            key = self._const_str(node.left)
+            if key is not None:
+                self.guarded.add(key)
+            else:
+                self.open = True
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if node.id == self.param and id(node) not in self._covered:
+            # the param escapes (passed whole to a helper, aliased,
+            # len()'d...): the walk can no longer claim completeness
+            self.open = True
+
+
+def _return_slots(fn_node):
+    """Output slot names derivable from the function's return statements.
+    Returns (slots, open)."""
+    slots, open_ = set(), False
+
+    def analyze(value):
+        nonlocal open_
+        if value is None or (isinstance(value, ast.Constant)
+                             and value.value is None):
+            return
+        if isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Name) and \
+                value.func.id == 'out':
+            slots.add('Out')  # ops/common.py out(x) -> {'Out': [x]}
+            return
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    if k.value != '__env_update__':
+                        slots.add(k.value)
+                else:
+                    open_ = True
+            return
+        if isinstance(value, ast.IfExp):
+            analyze(value.body)
+            analyze(value.orelse)
+            return
+        open_ = True
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return):
+            analyze(node.value)
+    return slots, open_
+
+
+_MODULE_FN_INDEX = {}  # filename -> [FunctionDef]
+
+
+def _find_fn_node(compute):
+    """The FunctionDef AST node of a compute function, via a per-module
+    parse (inspect.getsource per function re-tokenizes the file each
+    time — across ~30 op types that is the whole cold-verify budget)."""
+    code = getattr(compute, '__code__', None)
+    if code is None:
+        return None
+    fname = code.co_filename
+    nodes = _MODULE_FN_INDEX.get(fname)
+    if nodes is None:
+        try:
+            with open(fname) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError, ValueError):
+            nodes = []
+        else:
+            nodes = [n for n in ast.walk(tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+        _MODULE_FN_INDEX[fname] = nodes
+    want = code.co_firstlineno
+    for n in nodes:
+        lines = [n.lineno] + [d.lineno for d in n.decorator_list]
+        if want in lines and n.name == compute.__name__:
+            return n
+    return None
+
+
+def _introspect_signature(compute):
+    fn = _find_fn_node(compute)
+    if fn is None:
+        try:
+            src = textwrap.dedent(inspect.getsource(compute))
+            tree = ast.parse(src)
+        except (OSError, TypeError, SyntaxError, IndentationError):
+            return _OPEN_SIGNATURE
+        fn = next((n for n in tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))), None)
+    if fn is None or len(fn.args.args) < 3:
+        return _OPEN_SIGNATURE
+    ins_param = fn.args.args[1].arg
+    attrs_param = fn.args.args[2].arg
+
+    ins_v = _SigVisitor(ins_param)
+    attrs_v = _SigVisitor(attrs_param)
+    for stmt in fn.body:
+        ins_v.visit(stmt)
+        attrs_v.visit(stmt)
+    out_slots, out_open = _return_slots(fn)
+    return OpSignature(
+        in_slots=frozenset(ins_v.keys - {'__env__'}),
+        in_open=ins_v.open,
+        out_slots=frozenset(out_slots),
+        out_open=out_open,
+        attr_keys=frozenset(attrs_v.keys),
+        required_attrs=frozenset(attrs_v.required - attrs_v.guarded),
+    )
+
+
+def op_signature(type):
+    """OpSignature for a registered op type (None when unregistered).
+    Introspected once per process and cached — the verifier calls this
+    for every op of every plan build."""
+    impl = _OP_REGISTRY.get(type)
+    if impl is None:
+        return None
+    sig = _SIG_CACHE.get(type)
+    if sig is None:
+        sig = _introspect_signature(impl.compute)
+        _SIG_CACHE[type] = sig
+    return sig
 
 
 def registered_ops():

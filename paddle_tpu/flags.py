@@ -208,6 +208,19 @@ DEFINE_float('fleet_drain_timeout_s', 30.0,
              'before the fleet closes it anyway — bounds how long '
              'remove_replica(), deploy() old-version retirement, and '
              'fleet.close() can block on a stuck replica')
+DEFINE_string('verify_ir', 'boundary',
+              'static program verifier over the pass-manager rewrite '
+              'pipeline (transpiler/verify.py): "boundary" (default) '
+              'checks the final rewritten block once per plan build — '
+              'def-before-use, op signatures vs the registry, declared '
+              'dtype/shape vs re-inference, op_seq monotonicity, pinned-'
+              'name and AMP-cast invariants, donation-ordering safety; '
+              '"every_pass" re-checks after each pass and attributes a '
+              'failure to the offending pass (debug mode, used by the '
+              'mutation tests); "off" skips verification and restores '
+              'the pre-verifier plan-build path verbatim.  Re-read on '
+              'every plan build and part of the composite plan-cache '
+              'key, so flips take effect without a restart')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -148,15 +148,27 @@ class LayerHelper(object):
     def append_op(self, type, inputs=None, outputs=None, attrs=None,
                   infer_shape=True):
         """Append the op to the current block and run shape inference to
-        fill in the symbolic output shapes/dtypes."""
+        fill in the symbolic output shapes/dtypes.  With
+        infer_shape=False the declarations are left alone (pre-declared
+        outputs: optimizer params/accumulators, LR counters) but the
+        abstract evaluation still runs to prime the process-global
+        inference memo — the IR verifier re-infers the same op per plan
+        build, and a warm memo keeps that off the plan-build path."""
         block = self.main_program.current_block()
         op = block.append_op(type=type, inputs=inputs, outputs=outputs,
                              attrs=attrs)
-        if infer_shape:
-            self._infer_shapes(block, op)
+        self._infer_shapes(block, op, declare=infer_shape)
         return op
 
-    def _infer_shapes(self, block, op):
+    def _infer_shapes(self, block, op, declare=True):
+        from ..core.registry import op_traits
+        traits = op_traits(op.type)
+        if traits.needs_env or not traits.registered or \
+                'sub_block' in op.attrs or 'block' in op.attrs:
+            # env/control-flow ops can't abstractly evaluate (they need
+            # the live env); attempting it would just pay a failing
+            # trace, and the IR verifier skips them too
+            return
         input_specs = {}
         for slot, names in op.inputs.items():
             specs = []
@@ -168,10 +180,17 @@ class LayerHelper(object):
                     specs.append(None)
             input_specs[slot] = specs
         try:
-            outs = infer.infer_outputs(op.type, input_specs, op.attrs,
-                                       list(op.outputs))
+            # memoized: the IR verifier re-infers the same (op, specs,
+            # attrs) triple at plan build, and identical layers repeat
+            # within and across programs — one abstract evaluation
+            # serves them all (core/infer.py _INFER_CACHE)
+            outs = infer.infer_outputs_cached(op.type, input_specs,
+                                              op.attrs,
+                                              list(op.outputs))
         except Exception:
             return  # shape inference is best-effort at build time
+        if not declare:
+            return  # memo primed; declarations stay as pre-declared
         for slot, names in op.outputs.items():
             for n, spec in zip(names, outs.get(slot, [])):
                 if spec is None:

@@ -435,10 +435,12 @@ def accuracy(input, label, k=1, correct=None, total=None, **kwargs):
     if total is None:
         total = helper.create_tmp_variable(dtype='int32',
                                            stop_gradient=True)
+    # the reference accuracy_op also declares top_k's 'Out' as an input,
+    # but only ever reads Indices/Label (accuracy_op.h) — the IR
+    # verifier flags the vestigial slot, so it is not declared here
     helper.append_op(
         type='accuracy',
-        inputs={'Out': [topk_out], 'Indices': [topk_indices],
-                'Label': [label]},
+        inputs={'Indices': [topk_indices], 'Label': [label]},
         outputs={'Accuracy': [acc_out], 'Correct': [correct],
                  'Total': [total]})
     return acc_out

@@ -1,5 +1,9 @@
 from .memory_optimize import memory_optimize, release_memory  # noqa: F401
 from . import passes  # noqa: F401
 from .passes import run_pipeline  # noqa: F401
+from . import pass_manager  # noqa: F401
+from . import verify  # noqa: F401
+from .verify import IRVerificationError  # noqa: F401
 
-__all__ = ['memory_optimize', 'release_memory', 'passes', 'run_pipeline']
+__all__ = ['memory_optimize', 'release_memory', 'passes', 'run_pipeline',
+           'pass_manager', 'verify', 'IRVerificationError']

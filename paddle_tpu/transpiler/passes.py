@@ -32,8 +32,6 @@ removal because every surviving op is stamped with its pre-pass position
 (``op_seq``) and the executor derives per-op PRNG keys from that stamp.
 """
 import collections
-import copy
-import time
 
 import numpy as np
 
@@ -377,9 +375,16 @@ def _eval_op(op, const_env):
 def _materialize_const(src_op, name, value):
     """Build the op that re-defines a folded-away constant where it is
     still consumed: the original op when it was already a pure constant
-    source, else a single assign_value holding the computed value."""
+    source, else a single assign_value holding the computed value.
+
+    Materialized ops carry NO op_seq stamp: they land at the consumer's
+    position, so a copied stamp would break the strictly-monotonic
+    stamp order the verifier enforces — and none of them touch PRNG, so
+    the positional fallback the executor uses for unstamped ops is
+    exact."""
     from ..core.program import Operator
     if src_op.type in CONST_SOURCE_OPS and not src_op.input_arg_names:
+        src_op.attrs.pop('op_seq', None)
         return src_op
     attrs = {
         'values': np.asarray(value),
@@ -387,8 +392,6 @@ def _materialize_const(src_op, name, value):
         'dtype': str(value.dtype),
         'op_role': src_op.attrs.get('op_role', 'forward'),
     }
-    if 'op_seq' in src_op.attrs:
-        attrs['op_seq'] = src_op.attrs['op_seq']
     return Operator(src_op.block, 'assign_value',
                     inputs={}, outputs={'Out': [name]}, attrs=attrs)
 
@@ -631,48 +634,21 @@ def analyze_donation(program, fetch_names=(), feed_names=()):
 
 def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
                  extra_protected=()):
-    """Run the pass pipeline over a deep copy of ``program``.
+    """Run the graph-opt pass pipeline over a deep copy of ``program``.
 
     Returns ``(optimized_program, report)``.  At level 0 the original
     program is returned untouched with a bypass report.  The report dict
     carries per-pass elimination counts, op totals, the donation
     analysis, and the pipeline wall time.
-    """
-    level = _resolve_level(level)
-    fetch_names = tuple(fetch_names)
-    feed_names = tuple(feed_names)
-    if level <= 0:
-        return program, {'level': 0, 'ops_before': None, 'ops_after': None,
-                         'eliminated': {}, 'pass_wall_s': 0.0}
-    t0 = time.perf_counter()
-    p = copy.deepcopy(program)
-    block = p.global_block()
-    _stamp_op_seq(block)
-    ops_before = len(block.ops)
-    # caller-pinned names (memory_optimize skip_opt_set, explicit
-    # extra_protected) are liveness roots as well as rewrite barriers
-    pinned = set(extra_protected) | set(
-        getattr(program, '_graph_opt_skip_set', None) or ())
-    persist = _persistable_names(p)
-    ctrl = _control_referenced_names(p)
-    protected = (set(fetch_names) | set(feed_names) | persist | ctrl
-                 | pinned)
 
-    eliminated = {'dce': dce_pass(p, fetch_names, extra_live=pinned)}
-    if level >= 2:
-        eliminated['fold'] = constant_fold_pass(
-            p, fetch_names, feed_names, protected,
-            no_fold=persist | ctrl | pinned)
-        eliminated['cse'] = cse_pass(p, fetch_names, feed_names,
-                                     protected)
-        # folding/dedup can orphan their upstream producers
-        eliminated['dce'] += dce_pass(p, fetch_names, extra_live=pinned)
-    report = {
-        'level': level,
-        'ops_before': ops_before,
-        'ops_after': len(block.ops),
-        'eliminated': eliminated,
-        'donation': analyze_donation(p, fetch_names, feed_names),
-        'pass_wall_s': time.perf_counter() - t0,
-    }
-    return p, report
+    Legacy entry point: since the PassManager refactor this delegates to
+    transpiler/pass_manager.run_pipeline with AMP and verification
+    pinned OFF — the graph-opt-only pipeline PR 3 shipped, unchanged.
+    The executor drives the full managed pipeline (graph-opt + AMP +
+    verifier) through pass_manager directly.
+    """
+    from . import pass_manager
+    return pass_manager.run_pipeline(
+        program, fetch_names=fetch_names, feed_names=feed_names,
+        level=_resolve_level(level), amp_mode='0', verify='off',
+        extra_protected=extra_protected)

@@ -116,6 +116,79 @@ def test_graph_opt_pipeline_survives_every_registered_op():
             % (t, survivors))
 
 
+# Generic attr values for the verifier sweep below: one benign value per
+# required-attr key (introspected by registry.op_signature).  The sweep
+# never EXECUTES these programs — the values only need to satisfy the
+# static checks and abstract evaluation.
+_SWEEP_ATTR_VALUES = {
+    'shape': [2], 'values': [0.0, 0.0], 'value': 1.0,
+    'out_dtype': 'float32', 'beam_size': 2, 'end_id': 0, 'start_id': 0,
+    'num_chunk_types': 2, 'max': 1.0, 'min': -1.0, 'max_norm': 1.0,
+    'offsets': [0], 'num_classes': 2, 'expand_times': [1],
+    'kernels': [2, 2], 'groups': 1, 'depth': 2, 'paddings': [0, 0],
+    'output_names': [], 'split_inputs': [], 'class_number': 2,
+    'memories': [], 'step_inputs': [], 'step_outputs': [],
+    'new_dim': 2, 'height': 4, 'axis': [0],
+    'pooled_height': 1, 'pooled_width': 1,
+    'unpooled_height': 1, 'unpooled_width': 1,
+}
+
+
+def _sweep_program(t):
+    """One signature-conformant single-op program for op type `t`, plus
+    the names to feed so def-before-use holds."""
+    import numpy as np
+    from paddle_tpu.core.program import Program
+
+    sig = registry.op_signature(t)
+    in_slots = sorted(sig.in_slots) or ([] if not sig.in_open else ['X'])
+    out_slots = sorted(sig.out_slots) or ['Out']
+    p = Program()
+    attrs = {}
+    feeds = []
+    for k in sorted(sig.required_attrs):
+        if k in ('sub_block', 'block'):
+            p.create_block()  # empty body: reads nothing from outside
+            p.current_block_idx = 0
+            attrs[k] = 1
+        elif k == 'condition':
+            attrs[k] = 'swp_cond'
+            feeds.append('swp_cond')
+        elif k == 'values':
+            attrs[k] = np.zeros((2,), np.float32)
+        elif k in _SWEEP_ATTR_VALUES:
+            attrs[k] = _SWEEP_ATTR_VALUES[k]
+        else:
+            raise AssertionError(
+                "op %r requires attr %r — add a benign value to "
+                "_SWEEP_ATTR_VALUES" % (t, k))
+    inputs = {s: ['swp_%s_%s' % (t, s)] for s in in_slots}
+    outputs = {s: ['swpout_%s_%s' % (t, s)] for s in out_slots}
+    feeds.extend(n for ns in inputs.values() for n in ns)
+    p.global_block().append_op(type=t, inputs=inputs, outputs=outputs,
+                               attrs=attrs)
+    fetches = [n for ns in outputs.values() for n in ns]
+    return p, tuple(fetches), tuple(feeds)
+
+
+def test_verifier_every_pass_over_every_registered_op():
+    """Sweep: every registered op's signature-conformant program runs
+    the FULL managed pipeline — graph-opt level 2, then again under AMP
+    bf16 — with the verifier at every_pass.  No op may trip a single
+    check (acceptance: the verifier passes clean over every registered
+    op)."""
+    from paddle_tpu.transpiler import pass_manager as pm
+
+    for t in registry.registered_ops():
+        p, fetches, feeds = _sweep_program(t)
+        for amp in ('0', 'bf16'):
+            out, rep = pm.run_pipeline(
+                p, fetch_names=fetches, feed_names=feeds, level=2,
+                amp_mode=amp, verify='every_pass')
+            assert rep['verify']['mode'] == 'every_pass'
+            assert rep['verify']['checks'] >= 1, (t, amp)
+
+
 def test_every_registered_op_is_executed_by_the_suite(request):
     if len(request.session.items) < FULL_SUITE_FLOOR:
         pytest.skip("op-coverage meta-test needs the full suite "

@@ -1,0 +1,129 @@
+"""Static consistency check for the pass-manager registry
+(transpiler/pass_manager.py PASSES).
+
+Every registered pass must declare a unique integer ordering, a
+non-empty report key, and a valid kind; every REWRITE pass must appear
+in the verifier mutation-test matrix (tests/test_verify.py
+PASS_MUTATIONS) so a new pass cannot ship without a corruption test
+proving the verifier catches its failure mode and attributes it.  Also
+cross-checks the plan builder: the default configurations (levels 0-2,
+AMP on/off) must each produce a plan in strictly ascending order.
+
+Runs standalone (``python tools/check_pass_registry.py``, exit 1 on
+failure) and in tier-1 via tests/test_pass_registry.py, which imports
+``check()`` so CI pays no extra interpreter start (the same wiring as
+check_flags_doc.py / check_amp_lists.py).
+"""
+import ast
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _mutation_matrix_keys():
+    """Pass names covered by tests/test_verify.py PASS_MUTATIONS,
+    read statically (the tests module must stay importable-free here —
+    pytest owns its runtime)."""
+    path = os.path.join(_REPO, 'tests', 'test_verify.py')
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == 'PASS_MUTATIONS' \
+                        and isinstance(node.value, ast.Dict):
+                    keys = []
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            keys.append(k.value)
+                        else:
+                            return None  # non-literal key: fail loudly
+                    return keys
+    return None
+
+
+def check():
+    """Returns a list of human-readable error strings (empty = OK)."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    from paddle_tpu.transpiler import pass_manager as pm
+
+    errors = []
+    if not pm.PASSES:
+        return ["pass registry is empty — import order bug?"]
+
+    orders = {}
+    for name, pd in sorted(pm.PASSES.items()):
+        if pd.name != name:
+            errors.append("pass %r is registered under key %r"
+                          % (pd.name, name))
+        if not isinstance(pd.order, int):
+            errors.append("pass %r declares a non-int order %r"
+                          % (name, pd.order))
+        elif pd.order in orders:
+            errors.append("pass %r reuses order %d (taken by %r) — "
+                          "ordering must be total"
+                          % (name, pd.order, orders[pd.order]))
+        else:
+            orders[pd.order] = name
+        if not (pd.report_key or '').strip():
+            errors.append("pass %r declares an empty report key — its "
+                          "per-pass report entry would be unreadable"
+                          % name)
+        if pd.kind not in ('rewrite', 'analysis'):
+            errors.append("pass %r has unknown kind %r" % (name, pd.kind))
+        if not callable(pd.fn):
+            errors.append("pass %r has a non-callable fn" % name)
+        if not callable(pd.enabled):
+            errors.append("pass %r has a non-callable enabled gate"
+                          % name)
+
+    # plans come out in strictly ascending declared order for every
+    # stock configuration
+    for level in (0, 1, 2):
+        for amp in (None, 'bf16', 'f16'):
+            plan = pm.build_plan(level, amp)
+            seq = [p.order for p in plan]
+            if seq != sorted(seq) or len(set(seq)) != len(seq):
+                errors.append(
+                    "build_plan(level=%d, amp=%r) is not strictly "
+                    "ordered: %s" % (level, amp,
+                                     [p.name for p in plan]))
+
+    matrix = _mutation_matrix_keys()
+    if matrix is None:
+        errors.append(
+            "tests/test_verify.py must define a literal PASS_MUTATIONS "
+            "dict (the verifier mutation-test matrix)")
+    else:
+        rewrite = {n for n, p in pm.PASSES.items() if p.kind == 'rewrite'}
+        for n in sorted(rewrite - set(matrix)):
+            errors.append(
+                "rewrite pass %r is missing from the PASS_MUTATIONS "
+                "matrix in tests/test_verify.py — add a corruption that "
+                "proves the verifier catches and attributes its "
+                "failure" % n)
+        for n in sorted(set(matrix) - set(pm.PASSES)):
+            errors.append(
+                "PASS_MUTATIONS entry %r does not name a registered "
+                "pass (renamed or removed?)" % n)
+    return errors
+
+
+def main():
+    errors = check()
+    for e in errors:
+        print("check_pass_registry: %s" % e, file=sys.stderr)
+    if errors:
+        return 1
+    from paddle_tpu.transpiler import pass_manager as pm
+    print("check_pass_registry: OK (%d passes, %d rewrite)"
+          % (len(pm.PASSES),
+             sum(1 for p in pm.PASSES.values() if p.kind == 'rewrite')))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
