@@ -131,23 +131,42 @@ def main():
         "samples": [round(s, 1) for s in samples],
     }
     if on_tpu:
-        # ONE MFU convention (round-6 reconciliation): FLOPs = 2 x MACs
-        # — ResNet-50 @224 is ~4.089 GMACs = 8.178 GFLOP/img forward —
-        # train ~ 3x fwd (fwd + dgrad + wgrad), over the 192 TFLOPS
-        # this part actually SUSTAINS on a square matmul (PERF.md
-        # flash-roofline calibration; PADDLE_TPU_PEAK_TFLOPS overrides
-        # for other parts).  This matches exp_conv.py's accounting.
-        # The r1-r5 `mfu` series divided MACs (not FLOPs) by the 197
-        # spec peak and read ~2.05x low — retracted (PERF.md "MFU
-        # accounting").
+        # MFU denominator comes from the static cost model when the plan
+        # carries one (transpiler/cost_model.py: exact per-op MACs from
+        # the IR, fwd counted per op, bwd = 2x the loss-contributing
+        # forward slice) — the per-PROGRAM replacement for the old hand
+        # constant "8.178 GFLOP/img fwd, train=3xfwd", which assumed
+        # every forward FLOP is differentiated and rounded the MAC count
+        # to a published figure.  Peak stays the 192 TFLOPS this part
+        # SUSTAINS on a square matmul (PERF.md flash-roofline
+        # calibration; PADDLE_TPU_PEAK_TFLOPS overrides).  The hand
+        # constant remains the fallback when no cost report exists
+        # (graph-opt level 0), and mfu_basis says which basis each row
+        # used.  (The r1-r5 mfu series divided MACs by the 197 spec
+        # peak and read ~2.05x low — retracted, PERF.md "MFU
+        # accounting".)
         peak = float(os.environ.get('PADDLE_TPU_PEAK_TFLOPS', 192.0))
-        train_flops_per_img = 3 * 2 * 4.089e9
-        result["mfu"] = round(
-            img_per_sec * train_flops_per_img / (peak * 1e12), 4)
-        result["mfu_basis"] = (
-            "flops=2xMAC (8.178 GFLOP/img fwd), train=3xfwd, "
-            "peak=%g TFLOPS measured; r1-r5 mfu series (MAC/197 spec) "
-            "reads 2.05x low" % peak)
+        cost = (exe.last_graph_opt_report or {}).get('cost')
+        if cost and cost['total']['flops']:
+            flops_per_step = cost['total']['flops']
+            steps_per_sec = img_per_sec / batch
+            result["mfu"] = round(
+                flops_per_step * steps_per_sec / (peak * 1e12), 4)
+            result["mfu_basis"] = (
+                "cost_model: per-op MACs from the IR (fwd %.3g + bwd "
+                "%.3g + opt %.3g FLOP/step), peak=%g TFLOPS measured"
+                % (cost['per_role'].get('forward', {}).get('flops', 0),
+                   cost['per_role'].get('backward', {}).get('flops', 0),
+                   cost['per_role'].get('optimize', {}).get('flops', 0),
+                   peak))
+        else:
+            train_flops_per_img = 3 * 2 * 4.089e9
+            result["mfu"] = round(
+                img_per_sec * train_flops_per_img / (peak * 1e12), 4)
+            result["mfu_basis"] = (
+                "hand fallback (no cost report): flops=2xMAC "
+                "(8.178 GFLOP/img fwd), train=3xfwd, peak=%g TFLOPS "
+                "measured" % peak)
     if os.environ.get('PADDLE_TPU_BENCH_TFLOPS') not in (None, '', '0'):
         # achieved compute rate from the compiler's own cost model —
         # opt-in: cost_analysis compiles a second copy of the step

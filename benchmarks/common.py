@@ -122,6 +122,29 @@ def _step_breakdown(exe, program, loss, feed_fn, k=None, chunk=2):
                 'chunks': rep['chunks'],
                 'step_s': round(wall / k, 6),
             }
+            # cost-model join (Executor.last_step_report phases): the
+            # modeled FLOPs/bytes each phase moves, so every breakdown
+            # row carries its own MFU denominator instead of a
+            # hand-derived constant.  MFU is derived HERE from the
+            # externally-synced wall (block_until_ready above) — the
+            # executor's own rate fields are absent on this
+            # return_numpy=False path because its residual would only
+            # measure host dispatch
+            comp = (rep.get('phases') or {}).get('compute') or {}
+            if 'flops_per_step' in comp:
+                modeled = {
+                    'flops_per_step': comp['flops_per_step'],
+                    'bytes_per_step': comp['bytes_per_step'],
+                    'intensity': round(comp['intensity'], 3),
+                    'per_role_flops': comp['per_role_flops'],
+                }
+                peak = os.environ.get('PADDLE_TPU_PEAK_TFLOPS')
+                row_compute_s = rows[label]['compute_s']
+                if peak and float(peak) > 0 and row_compute_s > 0:
+                    modeled['mfu'] = round(
+                        comp['flops_per_step'] /
+                        (row_compute_s * float(peak) * 1e12), 4)
+                rows[label]['modeled'] = modeled
     finally:
         for n in keys:
             if saved[n] is None:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as _obs
+from ..observability import timeline as _tlm
 from . import datatypes
 from .lod import LoDTensor
 from .place import default_place
@@ -667,10 +668,21 @@ class Executor(object):
         # that plan was built with the pipeline off) — see
         # transpiler/passes.run_pipeline
         self.last_graph_opt_report = None
-        # step-time breakdown of the most recent run_steps call
-        # (feed_s / feed_overlap_s / update_s / chunks) — the numbers
-        # behind benchmarks/common.py's where-did-the-time-go table
-        self.last_run_steps_report = None
+        # unified step report of the most recent run_steps call: the
+        # measured phase walls (feed_s / feed_overlap_s / update_s /
+        # compute_s residual, summing to ~wall_s) joined with the
+        # static cost model's per-phase FLOPs/bytes under 'phases' —
+        # the numbers behind benchmarks/common.py's
+        # where-did-the-time-go table and every bench row's MFU
+        self.last_step_report = None
+
+    @property
+    def last_run_steps_report(self):
+        """Deprecated alias (one release): the run_steps breakdown now
+        lives in ``last_step_report`` with the same keys (feed_s /
+        feed_overlap_s / update_s / chunks) plus the timeline-derived
+        wall/compute residuals and the cost-model phase annotations."""
+        return self.last_step_report
 
     # ------------------------------------------------------------------
     def run(self,
@@ -682,6 +694,21 @@ class Executor(object):
             scope=None,
             return_numpy=True,
             use_program_cache=True):
+        try:
+            return self._run_impl(program, feed, fetch_list,
+                                  feed_var_name, fetch_var_name, scope,
+                                  return_numpy, use_program_cache)
+        except BaseException:
+            # flight-recorder forensics (PADDLE_TPU_TRACE_DUMP_ON_ERROR):
+            # flush the last-N-steps timeline ring before re-raising —
+            # maybe_dump_on_error never raises and is a cached-bool
+            # no-op when disarmed
+            _tlm.maybe_dump_on_error()
+            raise
+
+    def _run_impl(self, program, feed, fetch_list, feed_var_name,
+                  fetch_var_name, scope, return_numpy,
+                  use_program_cache):
         if program is None:
             program = default_main_program()
         if not isinstance(program, Program):
@@ -697,7 +724,14 @@ class Executor(object):
 
         block = program.global_block()
 
+        # flight recorder (observability/timeline.py): one cached-bool
+        # check when disarmed, phase events on the shared ring when
+        # PADDLE_TPU_TRACE_DIR / _TRACE_DUMP_ON_ERROR armed it
+        tl = _tlm.ring_if_armed()
         mesh, dev = self._mesh_and_dev(program)
+        if tl is not None:
+            tl.set_step(self._step)
+            t_f0 = time.perf_counter()
         feed_arrays = _convert_feed(block, feed)
         # every buffer the executor stages itself this call (host data
         # in, device_put here) is dead the moment the step consumes it
@@ -709,6 +743,11 @@ class Executor(object):
                        not any(isinstance(v, jax.Array)
                                for v in feed_arrays.values()))
         feed_arrays = self._stage_feed(feed_arrays, mesh, dev)
+        if tl is not None and feed_arrays:
+            tl.record('executor.feed_stage', 'feed', t0=t_f0,
+                      dur=time.perf_counter() - t_f0,
+                      args={'bytes': _nbytes(feed_arrays),
+                            'donated': feed_donate})
 
         plan = self._get_plan(program, block, scope, feed_arrays,
                               tuple(fetch_names), use_program_cache,
@@ -742,6 +781,8 @@ class Executor(object):
         with _obs.span('executor.run'), \
                 _quiet_unused_donation(
                     feed_arrays if (feed_donate and fresh) else None):
+            if tl is not None:
+                t_d0 = time.perf_counter()
             if em is not None and fresh:
                 # first invocation of a fresh plan: jit compiles
                 # synchronously inside this call.  The inner span also
@@ -755,6 +796,13 @@ class Executor(object):
             else:
                 fetches, new_state = fn(feed_arrays, state_rw,
                                         state_ro, rng_key)
+            if tl is not None:
+                tl.record('executor.compile' if fresh
+                          else 'executor.dispatch',
+                          'compile' if fresh else 'compute', t0=t_d0,
+                          dur=time.perf_counter() - t_d0,
+                          args={'donated_state_bytes':
+                                _nbytes(state_rw)})
             for n, v in new_state.items():
                 scope.set(n, v)
             if return_numpy:
@@ -935,7 +983,12 @@ class Executor(object):
         try:
             prog, report = pass_manager.run_pipeline(
                 program, fetch_names=fetch_names,
-                feed_names=tuple(sorted(feed_arrays)))
+                feed_names=tuple(sorted(feed_arrays)),
+                # concrete feed shapes seed the cost model's shape
+                # propagation (declared -1 batch dims resolve to the
+                # real batch, so FLOPs/bytes are exact per step)
+                feed_specs={n: (tuple(v.shape), str(v.dtype))
+                            for n, v in feed_arrays.items()})
         except IRVerificationError:
             if _obs.enabled():
                 _em().ir_verify_failures.inc()
@@ -1011,7 +1064,9 @@ class Executor(object):
                   scope=None, repeat=None, return_numpy=True):
         """Run K training steps as ONE compiled XLA computation — a
         lax.scan over the step function with the persistable state as
-        donated carry.
+        donated carry.  Populates ``last_step_report`` (measured phase
+        walls × cost-model FLOPs/bytes) and, when the flight recorder
+        is armed, exports the timeline ring to PADDLE_TPU_TRACE_DIR.
 
         TPU-native executor extension (no reference counterpart): over a
         network-attached accelerator each run() costs a host dispatch
@@ -1026,6 +1081,16 @@ class Executor(object):
         :param fetch_list: fetched per step; returns [K, ...]-stacked
             arrays, one per fetch.
         """
+        try:
+            return self._run_steps_impl(program, feed, fetch_list,
+                                        scope, repeat, return_numpy)
+        except BaseException:
+            _tlm.maybe_dump_on_error()
+            raise
+
+    def _run_steps_impl(self, program, feed, fetch_list, scope, repeat,
+                        return_numpy):
+        t_call = time.perf_counter()
         if program is None:
             program = default_main_program()
         if scope is None:
@@ -1074,19 +1139,23 @@ class Executor(object):
         # per-call step-time breakdown (benchmarks/common.py reads it):
         # feed_s = host feed staging on the critical path (device
         # idle), feed_overlap_s = staging done while a previous chunk
-        # was executing, update_s = scope write-back.
+        # was executing, update_s = scope write-back.  _finalize_step_
+        # report joins these with the cost model under 'phases'.
         report = {'k': k, 'device_prefetch': prefetch,
                   'chunks': 1, 'chunk_steps': k,
                   'feed_s': 0.0, 'feed_overlap_s': 0.0,
-                  'update_s': 0.0}
-        self.last_run_steps_report = report
+                  'update_s': 0.0, 'feed_bytes': 0}
+        self.last_step_report = report
         em = _em() if _obs.enabled() else None
+        tl = _tlm.ring_if_armed()
+        if tl is not None:
+            tl.set_step(self._step)
 
         if prefetch:
             return self._run_steps_prefetch(
                 program, block, scope, feeds, k, feed0, fetch_names,
                 rw_names, ro_names, raw_fn, mesh, dev, em, report,
-                return_numpy)
+                return_numpy, t_call)
 
         multi, multi_fresh = self._multi_plan(
             program, scope, feed0, fetch_names, rw_names, ro_names,
@@ -1097,6 +1166,12 @@ class Executor(object):
             tf = time.perf_counter()
             xs = self._stack_chunk(feeds, 0, k, block, dev)
             report['feed_s'] = time.perf_counter() - tf
+            report['feed_bytes'] = _nbytes(xs)
+            if tl is not None:
+                tl.record('executor.feed_stack', 'feed', t0=tf,
+                          dur=report['feed_s'],
+                          args={'bytes': report['feed_bytes'],
+                                'steps': k})
 
         state_rw = self._stage_state(
             {n: scope.get(n) for n in rw_names}, mesh, dev)
@@ -1128,11 +1203,24 @@ class Executor(object):
             for n, v in last_extra.items():
                 scope.set(n, v)
             report['update_s'] = time.perf_counter() - tu
+            if tl is not None:
+                tl.record('executor.scope_update', 'update', t0=tu,
+                          dur=report['update_s'])
             if em is not None and return_numpy:
                 self._note_amp_skips(rw_f, scope)
             if return_numpy:
-                return [np.asarray(y) for y in ys]
-            return list(ys)
+                ts = time.perf_counter()
+                outs = [np.asarray(y) for y in ys]
+                if tl is not None:
+                    tl.record('executor.fetch_sync', 'compute', t0=ts,
+                              dur=time.perf_counter() - ts,
+                              args={'steps': k})
+            else:
+                outs = list(ys)
+            self._finalize_step_report(
+                report, t_call,
+                synced=return_numpy and bool(fetch_names))
+            return outs
 
     def _multi_plan(self, program, scope, feed0, fetch_names, rw_names,
                     ro_names, mesh, raw_fn, k, stacked):
@@ -1171,6 +1259,8 @@ class Executor(object):
         The donation-warning filter arms only on that compiling call —
         steady-state dispatches must not touch the process-global
         warnings state."""
+        tl = _tlm.ring_if_armed()
+        td = time.perf_counter() if tl is not None else None
         with _quiet_unused_donation(
                 xs if (xs is not None and fresh) else None):
             if em is not None and fresh:
@@ -1179,8 +1269,19 @@ class Executor(object):
                     out = multi(feed0, xs, state_rw, state_ro, key0, t0)
                     em.compile_seconds.observe(time.perf_counter() - tc)
                 em.compiles.inc()
-                return out
-            return multi(feed0, xs, state_rw, state_ro, key0, t0)
+            else:
+                out = multi(feed0, xs, state_rw, state_ro, key0, t0)
+        if tl is not None:
+            # compile is synchronous inside the fresh call; cached
+            # dispatches return before the device finishes (jax async) —
+            # the event times the host-side dispatch, the device work
+            # shows under executor.fetch_sync / the jax profiler trace
+            tl.record('executor.compile' if fresh
+                      else 'executor.dispatch',
+                      'compile' if fresh else 'compute', t0=td,
+                      dur=time.perf_counter() - td,
+                      args={'donated_state_bytes': _nbytes(state_rw)})
+        return out
 
     def _stack_chunk(self, feeds, lo, hi, block, dev):
         """Stack feeds[lo:hi] into device-staged [hi-lo, ...] columns
@@ -1204,7 +1305,7 @@ class Executor(object):
     def _run_steps_prefetch(self, program, block, scope, feeds, k,
                             feed0, fetch_names, rw_names, ro_names,
                             raw_fn, mesh, dev, em, report,
-                            return_numpy):
+                            return_numpy, t_call):
         """Device-resident run_steps (PADDLE_TPU_DEVICE_PREFETCH): the
         K-step feed stack is staged in chunks through a double-buffered
         pipeline — the host stacks and device_puts chunk c+1 while the
@@ -1289,6 +1390,7 @@ class Executor(object):
                 if em is not None:
                     em.feed_bytes.inc(nb)
                     em.donated_feed_bytes.inc(nb)
+                report['feed_bytes'] += nb
                 return lo, hi, xs
             return thunk
 
@@ -1313,6 +1415,9 @@ class Executor(object):
             try:
                 for lo, hi, xs in device_prefetch(
                         make_thunk(lo, hi) for lo, hi in bounds):
+                    tl0 = _tlm.ring_if_armed()
+                    if tl0 is not None:
+                        tl0.set_step(base + lo)
                     multi, fresh = self._multi_plan(
                         program, scope, feed0, fetch_names, rw_names,
                         ro_names, mesh, raw_fn, hi - lo, True)
@@ -1366,8 +1471,13 @@ class Executor(object):
             for n, v in last_extra.items():
                 scope.set(n, v)
             report['update_s'] = time.perf_counter() - tu
+            tl = _tlm.ring_if_armed()
+            if tl is not None:
+                tl.record('executor.scope_update', 'update', t0=tu,
+                          dur=report['update_s'])
             if em is not None and return_numpy:
                 self._note_amp_skips(state_rw, scope)
+            ts = time.perf_counter()
             outs = []
             for i in range(len(fetch_names)):
                 parts = [p[i] for p in ys_parts]
@@ -1377,7 +1487,81 @@ class Executor(object):
                 else:
                     outs.append(parts[0] if len(parts) == 1
                                 else jnp.concatenate(parts))
+            if tl is not None and return_numpy and fetch_names:
+                tl.record('executor.fetch_sync', 'compute', t0=ts,
+                          dur=time.perf_counter() - ts,
+                          args={'steps': k})
+            self._finalize_step_report(
+                report, t_call,
+                synced=return_numpy and bool(fetch_names))
             return outs
+
+    def _finalize_step_report(self, report, t_call, synced=False):
+        """Join the measured run_steps phase walls with the static
+        cost-model report (transpiler/cost_model.py, cached per plan in
+        last_graph_opt_report['cost']) into ``last_step_report``:
+
+        - ``wall_s`` = whole-call wall; ``compute_s`` = the residual
+          after feed_s + update_s, i.e. device scan + fetch sync — the
+          three phases sum to ~wall by construction.
+        - ``phases`` = {feed, compute, update}, each with its wall and
+          the modeled bytes/FLOPs that phase moves per step; compute
+          carries per-role FLOPs and arithmetic intensity, plus
+          achieved FLOP/s and — when PADDLE_TPU_PEAK_TFLOPS is set —
+          MFU, but ONLY when ``synced`` (the fetch conversion forced
+          the device scan to completion inside the measured window).
+          A return_numpy=False call returns before the device
+          finishes, so its residual measures host dispatch only —
+          publishing a rate from it would overstate MFU by the
+          device-time/dispatch-time ratio.  Callers that sync
+          externally (benchmarks/common.py _step_breakdown) derive
+          MFU from their own synced wall and the modeled
+          flops_per_step instead.
+
+        Also flushes the timeline ring to PADDLE_TPU_TRACE_DIR when the
+        flight recorder is armed (one atomic trace_<pid>.json per
+        run_steps call)."""
+        import os as _os
+        wall = time.perf_counter() - t_call
+        k = max(int(report.get('k', 1)), 1)
+        compute = max(wall - report['feed_s'] - report['update_s'], 0.0)
+        report['wall_s'] = wall
+        report['compute_s'] = compute
+        report['synced'] = bool(synced)
+        cost = (self.last_graph_opt_report or {}).get('cost')
+        feed_phase = {'wall_s': report['feed_s'],
+                      'overlap_s': report['feed_overlap_s'],
+                      'bytes': report.get('feed_bytes', 0)}
+        compute_phase = {'wall_s': compute}
+        update_phase = {'wall_s': report['update_s']}
+        if cost is not None:
+            total = cost['total']
+            compute_phase.update({
+                'flops': total['flops'] * k,
+                'bytes': total['bytes'] * k,
+                'flops_per_step': total['flops'],
+                'bytes_per_step': total['bytes'],
+                'intensity': total['intensity'],
+                'per_role_flops': {r: v['flops']
+                                   for r, v in cost['per_role'].items()},
+            })
+            if synced and compute > 0.0 and total['flops']:
+                compute_phase['flops_per_s'] = total['flops'] * k / \
+                    compute
+                peak = _os.environ.get('PADDLE_TPU_PEAK_TFLOPS')
+                if peak:
+                    compute_phase['mfu'] = (
+                        compute_phase['flops_per_s'] /
+                        (float(peak) * 1e12))
+            if cost.get('feed_bytes') is not None:
+                feed_phase['modeled_bytes_per_step'] = cost['feed_bytes']
+            update_phase['state_bytes'] = cost.get('state_bytes', 0)
+        report['phases'] = {'feed': feed_phase,
+                            'compute': compute_phase,
+                            'update': update_phase}
+        report['cost'] = cost
+        _tlm.maybe_flush()
+        return report
 
     def _compile_common(self, program, feed, fetch_list, scope):
         if program is None:
@@ -1439,6 +1623,7 @@ class Executor(object):
         self._cache.clear()
         self._plan_reports.clear()
         self.last_graph_opt_report = None
+        self.last_step_report = None
         self._mesh_op_cache.clear()
         if hasattr(self, '_sharded_cache'):
             self._sharded_cache.clear()

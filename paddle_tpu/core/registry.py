@@ -95,8 +95,39 @@ def amp_class(type):
     return 'grey'
 
 
+# ---------------------------------------------------------------------------
+# Cost-model op classification — consumed by transpiler/cost_model.py
+# (the static per-op FLOPs/bytes analysis pass) and reported through
+# op_traits().cost.
+#
+# COST_MAC: ops whose dominant cost is multiply-accumulates on the MXU —
+# each has an exact closed-form MAC formula in
+# transpiler/cost_model.MAC_FORMULAS (shape-derived, no sampling).  This
+# is deliberately the AMP_WHITE set: "FLOPs land on the MXU" is the same
+# property both classifications name, and keeping them equal means a new
+# matmul-shaped op registered WHITE without a MAC formula fails the
+# cost-coverage sweep instead of silently costing zero.
+#
+# Everything else registered is COST class 'bytes': the roofline cost of
+# an elementwise/reduction/reshape op is the memory traffic it moves
+# (inputs read + outputs written), not its ALU count — its FLOPs column
+# reads 0 by convention and its bytes column is exact from shapes.
+# Ops with no per-op dense-tensor cost at all (control flow whose cost
+# is its body's, SelectedRows plumbing) carry explicit waivers in
+# transpiler/cost_model.WAIVED_OPS.
+COST_MAC = frozenset(AMP_WHITE)
+
+
+def cost_class(type):
+    """'mac' | 'bytes' cost classification for an op type (see COST_MAC
+    above; transpiler/cost_model.py holds the formulas and the
+    explicit no-verdict waivers)."""
+    return 'mac' if type in COST_MAC else 'bytes'
+
+
 OpTraits = collections.namedtuple(
-    'OpTraits', ['registered', 'stateful_rng', 'needs_env', 'amp'])
+    'OpTraits', ['registered', 'stateful_rng', 'needs_env', 'amp',
+                 'cost'])
 
 
 class OpImpl(object):
@@ -134,17 +165,19 @@ def has_op(type):
 
 
 def op_traits(type):
-    """OpTraits(registered, stateful_rng, needs_env, amp) for an op type
-    WITHOUT marking it as executed — the graph-opt and AMP pipelines
-    classify every op in a block, and routing that through get_op_impl
-    would make the coverage meta-test (called_ops) see phantom
-    executions.  `amp` is 'white' | 'black' | 'grey' (see AMP_WHITE /
-    AMP_BLACK above; grey = follow-the-inputs default)."""
+    """OpTraits(registered, stateful_rng, needs_env, amp, cost) for an
+    op type WITHOUT marking it as executed — the graph-opt, AMP, and
+    cost-model pipelines classify every op in a block, and routing that
+    through get_op_impl would make the coverage meta-test (called_ops)
+    see phantom executions.  `amp` is 'white' | 'black' | 'grey' (see
+    AMP_WHITE / AMP_BLACK above; grey = follow-the-inputs default);
+    `cost` is 'mac' | 'bytes' (see COST_MAC)."""
     impl = _OP_REGISTRY.get(type)
     if impl is None:
-        return OpTraits(False, False, False, amp_class(type))
+        return OpTraits(False, False, False, amp_class(type),
+                        cost_class(type))
     return OpTraits(True, impl.stateful_rng, impl.needs_env,
-                    amp_class(type))
+                    amp_class(type), cost_class(type))
 
 
 # ---------------------------------------------------------------------------

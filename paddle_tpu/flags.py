@@ -221,6 +221,33 @@ DEFINE_string('verify_ir', 'boundary',
               'the pre-verifier plan-build path verbatim.  Re-read on '
               'every plan build and part of the composite plan-cache '
               'key, so flips take effect without a restart')
+DEFINE_string('trace_dir', '',
+              'arm the step-timeline flight recorder '
+              '(observability/timeline.py) and export it here: the '
+              'executor records per-step phase events (feed staging, '
+              'compile, dispatch, scope update, prefetch overlap) into '
+              'the bounded event ring and, after every run_steps call, '
+              'writes the ring as Chrome trace_event JSON '
+              '(trace_<pid>.json, atomic replace) loadable in Perfetto '
+              'or chrome://tracing.  Empty (default) records nothing on '
+              'the executor paths — one cached-bool check per call, the '
+              'same zero-cost contract as PADDLE_TPU_METRICS_ENABLED=0. '
+              'The ring is shared with the legacy profiler RecordEvent '
+              'API and bounded by PADDLE_TPU_PROFILER_EVENT_CAP')
+DEFINE_int('trace_steps', 256,
+           'how many trailing steps of timeline events each exported '
+           'trace retains (the flight-recorder window for both the '
+           'per-run_steps flush and the dump-on-error file).  0 exports '
+           'every event still in the ring; the ring itself stays '
+           'bounded by PADDLE_TPU_PROFILER_EVENT_CAP either way')
+DEFINE_bool('trace_dump_on_error', False,
+            'crash forensics: on any executor exception, flush the '
+            'last PADDLE_TPU_TRACE_STEPS steps of the timeline ring to '
+            'trace_<pid>_error.json under PADDLE_TPU_TRACE_DIR (or '
+            'PADDLE_TPU_PROFILE_DIR when no trace dir is set) before '
+            're-raising — a long run that dies at step 40k leaves its '
+            'final timeline behind.  Arming this also arms timeline '
+            'recording even without a trace dir')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -9,7 +9,14 @@ Layers:
 - :mod:`metrics` — process-wide, thread-safe registry of ``Counter`` /
   ``Gauge`` / ``Histogram`` (label support, bounded buckets).
 - :mod:`tracing` — ``span("executor.run")`` context managers feeding the
-  registry *and* annotating XLA traces (jax.profiler.TraceAnnotation).
+  registry *and* annotating XLA traces (jax.profiler.TraceAnnotation),
+  and — when the flight recorder is armed — the timeline ring.
+- :mod:`timeline` — the step-timeline flight recorder: ONE bounded ring
+  of per-step phase events (feed/compile/dispatch/update/prefetch),
+  exported as Chrome ``trace_event`` JSON (``PADDLE_TPU_TRACE_DIR``,
+  Perfetto-loadable) with last-N-steps crash dumps
+  (``PADDLE_TPU_TRACE_DUMP_ON_ERROR``).  profiler.py's RecordEvent
+  records into the same ring.
 - :mod:`exporters` — Prometheus text exposition + JSON snapshot.
 - :mod:`http` — opt-in stdlib ``/metrics`` + ``/healthz`` endpoint
   (``serve_metrics(port)``, gated by ``PADDLE_TPU_METRICS_PORT``).
@@ -30,6 +37,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
 from .tracing import span
 from .exporters import json_snapshot, prometheus_text
 from .http import MetricsHTTPServer, maybe_serve_from_env, serve_metrics
+from . import timeline
 
 __all__ = [
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
@@ -37,7 +45,7 @@ __all__ = [
     'enabled', 'set_enabled', 'reload_enabled', 'registry', 'span',
     'prometheus_text', 'json_snapshot', 'snapshot',
     'MetricsHTTPServer', 'serve_metrics', 'maybe_serve_from_env',
-    'counter', 'gauge', 'histogram',
+    'counter', 'gauge', 'histogram', 'timeline',
 ]
 
 

@@ -1,0 +1,236 @@
+"""Step-timeline flight recorder: ONE bounded event ring for the process.
+
+The reference profiler answered "where did the time go" with per-op CUDA
+events; our whole-program jit has no per-op dispatch to time, so the
+question moves up a level: per-STEP phases — feed staging, compile,
+dispatch, device sync, scope update, prefetch overlap — recorded from the
+instrumentation points the executor/prefetch/serving layers already own.
+This module is the one buffer those events land in:
+
+- **Bounded ring** — a deque capped by ``PADDLE_TPU_PROFILER_EVENT_CAP``
+  (the same bound the legacy profiler's ``_events`` used; profiler.py now
+  records *into this ring*, so exactly one event buffer exists).  Events
+  are plain dicts ``{name, cat, ts, dur, step, tid, args}`` with ``ts``
+  seconds relative to the process clock origin.
+- **Chrome trace export** — ``export_chrome_trace(path)`` renders the
+  ring as ``trace_event`` JSON (``ph: "X"`` complete events) loadable in
+  Perfetto / ``chrome://tracing``, alongside any ``jax.profiler``
+  annotations captured separately.  With ``PADDLE_TPU_TRACE_DIR`` set the
+  executor flushes ``trace_<pid>.json`` there after every ``run_steps``
+  call (atomic replace — the file is always a complete, loadable trace).
+- **Crash forensics** — ``PADDLE_TPU_TRACE_DUMP_ON_ERROR=1`` makes the
+  executor dump the last ``PADDLE_TPU_TRACE_STEPS`` steps of the ring to
+  ``trace_<pid>_error.json`` on any executor exception, so a long run
+  that dies at step 40k leaves its final timeline behind.
+
+Zero-cost when disabled: instrument sites guard on :func:`armed` /
+:func:`ring_if_armed` — one cached-bool check, no ring allocation, no
+clock read (``PADDLE_TPU_TRACE_DIR`` unset and dump-on-error off).  The
+legacy profiler API (``RecordEvent``, ``profiler()``) records
+unconditionally, exactly as it always did — bounded by the cap.
+"""
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ['ring', 'ring_if_armed', 'armed', 'reload_armed', 'reset',
+           'record', 'set_step', 'export_chrome_trace', 'maybe_flush',
+           'maybe_dump_on_error', 'Timeline']
+
+# process clock origin: every event's ts is perf_counter-relative to
+# this, so exported traces start near t=0 instead of an opaque epoch
+_PC0 = time.perf_counter()
+
+# event categories (the `cat` field; Perfetto colors/filters by it)
+CATEGORIES = ('feed', 'compute', 'compile', 'update', 'collective',
+              'donation', 'span', 'user')
+
+
+def _event_cap():
+    """PADDLE_TPU_PROFILER_EVENT_CAP as a deque maxlen (None=unbounded):
+    one bound shared with the legacy profiler API — long-lived serving
+    processes wrap every request in RecordEvent, and an unbounded list
+    is a slow leak."""
+    from ..flags import FLAGS
+    cap = int(FLAGS.profiler_event_cap)
+    return cap if cap > 0 else None
+
+
+class Timeline(object):
+    """Thread-safe bounded ring of timing events."""
+
+    def __init__(self, cap):
+        self._lock = threading.Lock()
+        self._dq = collections.deque(maxlen=cap)
+        self._step = 0
+
+    def set_step(self, step):
+        """Current global step — events recorded without an explicit
+        ``step`` are stamped with it (the executor advances it)."""
+        self._step = int(step)
+
+    @property
+    def step(self):
+        return self._step
+
+    def record(self, name, cat='user', t0=None, dur=0.0, step=None,
+               args=None):
+        """Append one complete event.  ``t0`` is a time.perf_counter()
+        reading (defaults to now - dur); ``dur`` is seconds."""
+        if t0 is None:
+            t0 = time.perf_counter() - dur
+        e = {'name': name, 'cat': cat, 'ts': t0 - _PC0,
+             'dur': float(dur),
+             'step': self._step if step is None else int(step),
+             'tid': threading.get_ident(), 'args': args}
+        with self._lock:
+            self._dq.append(e)
+
+    def events(self, cat=None, last_steps=0):
+        """Snapshot of the ring, optionally filtered to one category
+        and/or to events of the trailing ``last_steps`` steps."""
+        with self._lock:
+            evs = list(self._dq)
+        if cat is not None:
+            evs = [e for e in evs if e['cat'] == cat]
+        if last_steps:
+            steps = [e['step'] for e in evs]
+            if steps:
+                floor = max(steps) - int(last_steps)
+                evs = [e for e in evs if e['step'] > floor]
+        return evs
+
+    def clear(self):
+        with self._lock:
+            self._dq.clear()
+
+    def export_chrome_trace(self, path, last_steps=0):
+        """Write the ring as Chrome ``trace_event`` JSON (Perfetto /
+        chrome://tracing loadable).  Atomic: writes ``path + '.tmp'``
+        then os.replace, so a reader never sees a torn file.  Returns
+        ``path``."""
+        evs = self.events(last_steps=last_steps)
+        pid = os.getpid()
+        trace_events = [
+            {'name': 'process_name', 'ph': 'M', 'pid': pid, 'tid': 0,
+             'args': {'name': 'paddle_tpu executor (pid %d)' % pid}}]
+        for e in evs:
+            te = {'name': e['name'], 'cat': e['cat'], 'ph': 'X',
+                  'ts': round(e['ts'] * 1e6, 3),
+                  'dur': round(e['dur'] * 1e6, 3),
+                  'pid': pid, 'tid': e['tid'],
+                  'args': dict(e['args'] or {}, step=e['step'])}
+            trace_events.append(te)
+        doc = {'traceEvents': trace_events, 'displayTimeUnit': 'ms'}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+_ring = None
+_ring_lock = threading.Lock()
+# cached (record_armed, flush_armed, dump_armed) — the executor hot path
+# asks once per call; an os.environ read per step would be measurable
+_armed = None
+
+
+def ring():
+    """The process-wide ring (created lazily with the flag cap)."""
+    global _ring
+    if _ring is None:
+        with _ring_lock:
+            if _ring is None:
+                _ring = Timeline(_event_cap())
+    return _ring
+
+
+def _armed_tuple():
+    global _armed
+    if _armed is None:
+        from ..flags import FLAGS
+        trace_dir = (FLAGS.trace_dir or '').strip()
+        dump = bool(FLAGS.trace_dump_on_error)
+        _armed = (bool(trace_dir) or dump, bool(trace_dir), dump)
+    return _armed
+
+
+def armed():
+    """True when executor-side timeline recording is on: a trace dir is
+    configured (PADDLE_TPU_TRACE_DIR) or dump-on-error is armed."""
+    return _armed_tuple()[0]
+
+
+def ring_if_armed():
+    """The ring when recording is armed, else None — the one-cached-bool
+    guard executor instrumentation sites use."""
+    return ring() if _armed_tuple()[0] else None
+
+
+def reload_armed():
+    """Drop the cached arming so the next check re-reads the flags."""
+    global _armed
+    _armed = None
+
+
+def reset(cap=None):
+    """Clear the ring and re-read the caps/arming flags (the profiler's
+    reset_profiler() contract, now covering the shared ring).  ``cap``
+    overrides the flag-derived event cap."""
+    global _ring
+    with _ring_lock:
+        _ring = Timeline(_event_cap() if cap is None else (cap or None))
+    reload_armed()
+
+
+def record(name, cat='user', t0=None, dur=0.0, step=None, args=None):
+    """Record into the process ring unconditionally (legacy profiler
+    path).  Executor sites use ring_if_armed() instead."""
+    ring().record(name, cat=cat, t0=t0, dur=dur, step=step, args=args)
+
+
+def set_step(step):
+    ring().set_step(step)
+
+
+def export_chrome_trace(path, last_steps=0):
+    return ring().export_chrome_trace(path, last_steps=last_steps)
+
+
+def _trace_path(suffix=''):
+    from ..flags import FLAGS
+    d = (FLAGS.trace_dir or '').strip() or FLAGS.profile_dir
+    return os.path.join(d, 'trace_%d%s.json' % (os.getpid(), suffix))
+
+
+def maybe_flush():
+    """Export the ring to PADDLE_TPU_TRACE_DIR when configured (called
+    by the executor after run_steps).  Returns the path or None."""
+    if not _armed_tuple()[1]:
+        return None
+    from ..flags import FLAGS
+    try:
+        return ring().export_chrome_trace(
+            _trace_path(), last_steps=int(FLAGS.trace_steps))
+    except OSError:
+        return None  # an unwritable trace dir must not fail the step
+
+
+def maybe_dump_on_error():
+    """Flush the last-N-steps ring on an executor exception when
+    PADDLE_TPU_TRACE_DUMP_ON_ERROR is armed (crash forensics).  Never
+    raises — the original exception must surface, not a dump failure."""
+    if not _armed_tuple()[2]:
+        return None
+    try:
+        from ..flags import FLAGS
+        return ring().export_chrome_trace(
+            _trace_path('_error'), last_steps=int(FLAGS.trace_steps))
+    except Exception:
+        return None

@@ -17,6 +17,7 @@ a single function call.
 import time
 
 from . import metrics as _metrics
+from . import timeline as _timeline
 
 __all__ = ['span']
 
@@ -54,11 +55,12 @@ def _child(name):
 
 
 class _Span(object):
-    __slots__ = ('_child', '_ann', '_t0')
+    __slots__ = ('_child', '_ann', '_t0', '_name')
 
-    def __init__(self, child, ann):
+    def __init__(self, child, ann, name):
         self._child = child
         self._ann = ann
+        self._name = name
 
     def __enter__(self):
         if self._ann is not None:
@@ -67,7 +69,13 @@ class _Span(object):
         return self
 
     def __exit__(self, *exc):
-        self._child.observe(time.perf_counter() - self._t0)
+        dur = time.perf_counter() - self._t0
+        self._child.observe(dur)
+        # when the flight recorder is armed, the same region lands on
+        # the step timeline (one measurement, two sinks)
+        tl = _timeline.ring_if_armed()
+        if tl is not None:
+            tl.record(self._name, cat='span', t0=self._t0, dur=dur)
         if self._ann is not None:
             self._ann.__exit__(*exc)
         return False
@@ -89,4 +97,4 @@ def span(name, annotate=True):
     if annotate:
         import jax
         ann = jax.profiler.TraceAnnotation(name)
-    return _Span(_child(name), ann)
+    return _Span(_child(name), ann, name)

@@ -3,13 +3,24 @@
 Reference parity: python/paddle/v2/fluid/profiler.py (cuda_profiler,
 profiler context, reset_profiler) re-based on jax.profiler: traces are
 XLA/TPU traces viewable in TensorBoard/Perfetto instead of nvprof output.
+
+Host-side events (``RecordEvent``, the ``profiler()`` region) record
+into the ONE process event buffer — the step-timeline ring in
+:mod:`paddle_tpu.observability.timeline` — instead of a private deque,
+so the executor's flight-recorder events and the user's RecordEvent
+regions land on the same exported Chrome trace.  The public API is
+unchanged: ``get_events()`` still returns ``(name, seconds)`` tuples
+(the user-category view of the shared ring), ``reset_profiler()`` still
+re-reads ``PADDLE_TPU_PROFILER_EVENT_CAP`` — it now resets the shared
+ring, executor events included.
 """
 import contextlib
 import os
 import time
-from collections import deque
 
 import jax
+
+from .observability import timeline as _timeline
 
 __all__ = ['profiler', 'cuda_profiler', 'CudaProfiler',
            'reset_profiler', 'RecordEvent',
@@ -19,13 +30,13 @@ __all__ = ['profiler', 'cuda_profiler', 'CudaProfiler',
 def _event_cap():
     """PADDLE_TPU_PROFILER_EVENT_CAP as a deque maxlen (None=unbounded):
     long-lived serving processes wrap every request in RecordEvent, and
-    an unbounded list is a slow leak."""
+    an unbounded list is a slow leak.  The cap bounds the SHARED
+    timeline ring (observability/timeline.py) — one buffer, one bound."""
     from .flags import FLAGS
     cap = int(FLAGS.profiler_event_cap)
     return cap if cap > 0 else None
 
 
-_events = deque(maxlen=_event_cap())
 _last_log_dir = None
 
 
@@ -53,7 +64,8 @@ def profiler(state='All', sorted_key=None, log_dir='/tmp/paddle_tpu_prof'):
             if sorted_key is not None:
                 print(profile_table(sorted_key=sorted_key,
                                     log_dir=log_dir))
-        _events.append(('profile_region', time.time() - t0))
+        _timeline.record('profile_region', cat='user',
+                         dur=time.time() - t0)
 
 
 # The reference exposes cuda_profiler/CudaProfiler; on TPU both are the
@@ -151,14 +163,17 @@ def profile_table(sorted_key='total', log_dir=None):
 
 def reset_profiler():
     """Drop recorded events; re-reads the event-cap flag so a process
-    can resize the bound at runtime (set the env, then reset)."""
-    global _events
-    _events = deque(maxlen=_event_cap())
+    can resize the bound at runtime (set the env, then reset).  Resets
+    the SHARED timeline ring — executor flight-recorder events are
+    dropped with the profiler's (there is one buffer), and the
+    trace-export arming flags are re-read too."""
+    _timeline.reset(cap=_event_cap())
 
 
 class RecordEvent(object):
     """Named host-side timing region (parity with platform::RecordEvent);
-    also annotates device traces via jax.profiler.TraceAnnotation."""
+    also annotates device traces via jax.profiler.TraceAnnotation and
+    records into the shared step-timeline ring (cat 'user')."""
 
     def __init__(self, name):
         self.name = name
@@ -166,17 +181,23 @@ class RecordEvent(object):
     def __enter__(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        _events.append((self.name, time.time() - self._t0))
+        _timeline.record(self.name, cat='user', t0=self._t0,
+                         dur=time.perf_counter() - self._t0)
         self._ann.__exit__(*exc)
         return False
 
 
 def get_events():
-    return list(_events)
+    """Legacy view of the shared ring: ``(name, seconds)`` for the
+    user-recorded events (RecordEvent / profile regions); executor
+    flight-recorder events live in the same ring under their own
+    categories and are excluded here for back-compat."""
+    return [(e['name'], e['dur'])
+            for e in _timeline.ring().events(cat='user')]
 
 
 def cost_analysis(program, feed, fetch_list, scope=None, place=None):

@@ -1,0 +1,541 @@
+"""Static per-op cost model: FLOPs/bytes/arithmetic-intensity from the IR.
+
+The Fluid reference profiled per-op kernels at runtime; our whole-program
+jit fuses the step into one XLA computation, so runtime can only say how
+fast a step IS — this pass says where the work GOES, statically, from the
+post-rewrite plan IR.  It runs as a registered ANALYSIS pass under the
+PassManager (after graph-opt and AMP, so eliminated ops cost nothing and
+AMP-lowered values count their bf16/f16 bytes) and its report joins the
+measured step phases in ``Executor.last_step_report`` — MFU and roofline
+position come from the IR, not hand math in bench.py.
+
+Model, per op (classification lives in ``registry.op_traits().cost``):
+
+- **'mac' ops** (``registry.COST_MAC`` — the matmul-shaped set): exact
+  closed-form MAC counts derived from shapes (``MAC_FORMULAS``), FLOPs =
+  2 x MACs.  Bytes are counted too (inputs read + outputs written).
+- **'bytes' ops** (everything else): the roofline cost of an
+  elementwise/reduction/data-movement op is its memory traffic; FLOPs
+  read 0 by convention and bytes are exact from shapes.
+- **autodiff**: the single backward op is modeled as 2 x the cost of its
+  loss-contributing forward slice (dgrad + wgrad) — the per-program
+  derivation of the old hand constant "train = 3 x fwd", now honest
+  about metrics towers and other non-differentiated forward work.
+- **waived ops** (``WAIVED_OPS`` + control-flow/env/sub-block ops): no
+  per-op dense-tensor verdict exists; they are reported in
+  ``coverage['waived']``, never silently costed 0.
+
+Shapes resolve through the same machinery the IR verifier trusts: the
+executor's concrete feed specs seed an environment that
+``core/infer.py`` propagates op by op (memoized eval_shape), with
+declared VarDesc shapes as the fallback — so a -1 batch dim is concrete
+wherever a feed reaches it.
+"""
+import numpy as np
+
+from ..core import datatypes
+from ..core.registry import COST_MAC, cost_class, op_traits
+from . import passes
+
+__all__ = ['analyze_cost', 'op_cost', 'MAC_FORMULAS', 'WAIVED_OPS',
+           'FLOPS_BASIS']
+
+FLOPS_BASIS = ('FLOPs = 2 x MACs from closed-form per-op formulas '
+               '(registry.COST_MAC); elementwise/reduction ops cost '
+               'bytes-moved with FLOPs=0; autodiff (backward) = 2 x its '
+               'loss-contributing forward slice')
+
+# Ops with NO per-op dense-tensor cost verdict — each entry says why.
+# The coverage sweep (tests/test_zz_op_coverage.py) asserts every
+# registered op either yields a verdict or appears here; control-flow /
+# env / sub-block ops are waived structurally (their cost is their
+# body's) and need no entry.
+WAIVED_OPS = {
+    # modeled at the slice level (2 x forward), not as one op — a per-op
+    # formula would have to re-derive the whole program's backward
+    'autodiff': 'backward modeled as 2x the loss-contributing forward '
+                'slice',
+    # SelectedRows plumbing: emits a (rows, values) handle whose dense
+    # extent is data-dependent (touched rows), not shape-derivable
+    'sparse_grad_assemble': 'SelectedRows handle; touched-row count is '
+                            'data-dependent',
+    # LoDTensorArray handles: length/content are loop-carried state
+    'write_to_array': 'LoDTensorArray handle op',
+    'read_from_array': 'LoDTensorArray handle op',
+    'array_length': 'LoDTensorArray handle op',
+    'array_to_lod_tensor': 'LoDTensorArray handle op',
+    'lod_tensor_to_array': 'LoDTensorArray handle op',
+    # beam search carries ragged per-step hypothesis state
+    'beam_search': 'ragged beam state; extent is data-dependent',
+    'beam_search_decode': 'ragged beam state; extent is data-dependent',
+}
+
+
+def _prod(shape, unknown):
+    """Product of a shape with -1 dims counted as 1 (and tallied)."""
+    p = 1
+    for d in shape:
+        if d is None or d < 0:
+            unknown[0] += 1
+            continue
+        p *= int(d)
+    return p
+
+
+def _first(specs, slot, i=0):
+    vals = specs.get(slot) or []
+    if len(vals) <= i:
+        return None
+    return vals[i]
+
+
+def _dtype_bytes(dt):
+    try:
+        d = np.dtype(datatypes.as_numpy_dtype(dt))
+    except Exception:
+        return 4
+    if d.itemsize == 8 and d.kind in 'fiu':
+        return 4  # x64 is disabled: declared 64-bit runs 32-bit
+    return int(d.itemsize)
+
+
+def _spec_bytes(spec, unknown):
+    if spec is None:
+        return 0
+    shape, dt = spec
+    return _prod(shape, unknown) * _dtype_bytes(dt)
+
+
+# ---------------------------------------------------------------------------
+# Exact MAC formulas, one per COST_MAC op.  Each takes the resolved
+# (in_specs, out_specs, attrs) and returns a MAC count, or None when a
+# needed shape is missing (→ no verdict, reported in coverage).
+# ---------------------------------------------------------------------------
+
+def _macs_mul(ins, outs, attrs, unknown):
+    x = _first(ins, 'X')
+    o = _first(outs, 'Out')
+    if x is None or o is None:
+        return None
+    xnc = int(attrs.get('x_num_col_dims', 1))
+    k = _prod(x[0][xnc:], unknown)
+    return _prod(o[0], unknown) * k
+
+
+def _macs_matmul(ins, outs, attrs, unknown):
+    x = _first(ins, 'X')
+    o = _first(outs, 'Out')
+    if x is None or o is None:
+        return None
+    xs = x[0]
+    if len(xs) == 0:
+        return None
+    if len(xs) == 1:
+        k = xs[0]
+    elif attrs.get('transpose_X', False):
+        k = xs[-2]
+    else:
+        k = xs[-1]
+    if k is None or k < 0:
+        unknown[0] += 1
+        k = 1
+    return _prod(o[0], unknown) * int(k)
+
+
+def _macs_conv(ins, outs, attrs, unknown):
+    # Filter is (O, I/groups, k...) so prod(filter[1:]) is exactly the
+    # per-output-element MAC count
+    w = _first(ins, 'Filter')
+    o = _first(outs, 'Output')
+    if w is None or o is None:
+        return None
+    return _prod(o[0], unknown) * _prod(w[0][1:], unknown)
+
+
+def _macs_conv_transpose(ins, outs, attrs, unknown):
+    # filter is (in_c, out_c, k...): each INPUT element scatters into
+    # out_c * prod(k) outputs
+    x = _first(ins, 'Input')
+    w = _first(ins, 'Filter')
+    if x is None or w is None:
+        return None
+    return _prod(x[0], unknown) * _prod(w[0][1:], unknown)
+
+
+def _macs_sequence_conv(ins, outs, attrs, unknown):
+    # Filter [ctx_len*D, M]: one matmul over gathered context frames
+    w = _first(ins, 'Filter')
+    o = _first(outs, 'Out')
+    if w is None or o is None:
+        return None
+    return _prod(o[0], unknown) * int(w[0][0])
+
+
+def _macs_conv_shift(ins, outs, attrs, unknown):
+    x = _first(ins, 'X')
+    y = _first(ins, 'Y')
+    if x is None or y is None:
+        return None
+    return _prod(x[0], unknown) * int(y[0][-1])
+
+
+def _macs_row_conv(ins, outs, attrs, unknown):
+    x = _first(ins, 'X')
+    w = _first(ins, 'Filter')
+    if x is None or w is None:
+        return None
+    return _prod(x[0], unknown) * int(w[0][0])
+
+
+def _macs_bilinear(ins, outs, attrs, unknown):
+    # einsum 'ni,kij,nj->nk': B*K*M*N for x@W plus B*K*N for (..)·y
+    x = _first(ins, 'X')
+    w = _first(ins, 'Weight')
+    if x is None or w is None:
+        return None
+    b = _prod(x[0][:1], unknown)
+    k, m, n = (int(d) for d in w[0])
+    return b * k * n * (m + 1)
+
+
+def _macs_lstm(ins, outs, attrs, unknown):
+    # Input [B, T, 4H] pre-projected gates; recurrent matmul per step is
+    # [B, H] x [H, 4H] -> B*T*4H*H = prod(Input)*H
+    x = _first(ins, 'Input')
+    if x is None:
+        return None
+    h = int(x[0][-1]) // 4
+    return _prod(x[0], unknown) * h
+
+
+def _macs_lstm_unit(ins, outs, attrs, unknown):
+    # the unit op is the elementwise CELL only (gates are pre-projected
+    # outside): zero MACs, bytes-moved is its true cost
+    return 0
+
+
+def _macs_gru(ins, outs, attrs, unknown):
+    # Input [B, T, 3H]; per step [B,H]x[H,2H] + [B,H]x[H,H] = B*3H^2
+    x = _first(ins, 'Input')
+    if x is None:
+        return None
+    h = int(x[0][-1]) // 3
+    return _prod(x[0], unknown) * h
+
+
+def _macs_gru_unit(ins, outs, attrs, unknown):
+    x = _first(ins, 'Input')
+    if x is None:
+        return None
+    h = int(x[0][-1]) // 3
+    return _prod(x[0], unknown) * h
+
+
+def _macs_flash_attention(ins, outs, attrs, unknown):
+    # QK^T + PV: 2 * B*H*Tq*Tk*D
+    q = _first(ins, 'Q')
+    k = _first(ins, 'K')
+    if q is None or k is None:
+        return None
+    qs = q[0]
+    if len(qs) == 4:
+        b, tq, h, d = qs
+        tk = k[0][1]
+    elif len(qs) == 3:
+        b, tq, d = qs
+        h, tk = 1, k[0][1]
+    else:
+        return None
+    for v in (b, tq, h, d, tk):
+        if v is None or v < 0:
+            unknown[0] += 1
+            return None
+    return 2 * int(b) * int(h) * int(tq) * int(tk) * int(d)
+
+
+def _macs_vocab_ce(ins, outs, attrs, unknown):
+    # [N, D] x [D, V] vocab head (chunked or dense — same MACs)
+    x = _first(ins, 'X')
+    w = _first(ins, 'W')
+    if x is None or w is None:
+        return None
+    flatten = int(attrs.get('flatten', len(x[0]) - 1))
+    n = _prod(x[0][:flatten], unknown)
+    d = _prod(x[0][flatten:], unknown)
+    return n * d * int(w[0][1])
+
+
+MAC_FORMULAS = {
+    'mul': _macs_mul,
+    'matmul': _macs_matmul,
+    'conv2d': _macs_conv,
+    'conv3d': _macs_conv,
+    'conv2d_transpose': _macs_conv_transpose,
+    'conv3d_transpose': _macs_conv_transpose,
+    'sequence_conv': _macs_sequence_conv,
+    'conv_shift': _macs_conv_shift,
+    'row_conv': _macs_row_conv,
+    'bilinear_tensor_product': _macs_bilinear,
+    'lstm': _macs_lstm,
+    'lstm_unit': _macs_lstm_unit,
+    'gru': _macs_gru,
+    'gru_unit': _macs_gru_unit,
+    'flash_attention': _macs_flash_attention,
+    'fused_linear_softmax_ce': _macs_vocab_ce,
+    'vocab_parallel_ce': _macs_vocab_ce,
+}
+
+
+def _structurally_waived(op):
+    """Control-flow/env/sub-block ops: their cost is their body's, and
+    the body interprets under a different environment — no per-op
+    verdict (same skip set the IR verifier's re-inference uses)."""
+    traits = op_traits(op.type)
+    return (not traits.registered or traits.needs_env
+            or op.type in passes.EFFECTFUL_OPS
+            or any(k in op.attrs for k in passes._SUB_BLOCK_ATTR_KEYS))
+
+
+def op_cost(op_type, in_specs, out_specs, attrs):
+    """One op's cost verdict from resolved specs:
+    ``{'class', 'macs', 'flops', 'bytes', 'unknown_dims'}`` or None
+    when the needed shapes are missing."""
+    unknown = [0]
+    nbytes = 0
+    for specs in (in_specs, out_specs):
+        for slot, vals in specs.items():
+            for s in vals:
+                nbytes += _spec_bytes(s, unknown)
+    cls = cost_class(op_type)
+    macs = 0
+    if cls == 'mac':
+        fn = MAC_FORMULAS.get(op_type)
+        if fn is None:
+            return None  # COST_MAC without a formula: coverage failure
+        macs = fn(in_specs, out_specs, attrs, unknown)
+        if macs is None:
+            return None
+    if nbytes == 0 and macs == 0:
+        return None  # nothing resolvable: no verdict, not "free"
+    return {'class': cls, 'macs': int(macs), 'flops': 2 * int(macs),
+            'bytes': int(nbytes), 'unknown_dims': unknown[0]}
+
+
+# ---------------------------------------------------------------------------
+# the program walk
+# ---------------------------------------------------------------------------
+
+def _batch_binding(block, feed_specs):
+    """The concrete size of the -1 batch dimension, recovered by
+    matching a feed's declared shape against its fed shape.  One
+    binding per program — the unknown dim IS the batch in this IR
+    (layers declare ``(-1, ...)`` and everything else is static)."""
+    for n in sorted(feed_specs or {}):
+        shape, _dt = feed_specs[n]
+        try:
+            v = block.var_recursive(n)
+        except KeyError:
+            continue
+        if v.shape and len(v.shape) == len(shape):
+            for dv, dc in zip(v.shape, shape):
+                if dv == -1:
+                    return int(dc)
+    return None
+
+
+def _declared_spec(block, name, batch=None):
+    """Declared VarDesc spec with -1 dims bound to the feed batch.
+    This is the ONE resolution both the batched prime and the per-op
+    walk use — they must produce identical specs or the prime's memo
+    keys never hit (the batching would silently degrade to a per-op
+    eval_shape per program op)."""
+    try:
+        v = block.var_recursive(name)
+    except KeyError:
+        return None
+    if not v.shape and v.lod_level == 0 and not v.is_data:
+        return None
+    shape = tuple(batch if (d == -1 and batch is not None) else d
+                  for d in v.shape)
+    return (shape, v.dtype)
+
+
+def _resolve_in_specs(block, op, env, batch):
+    specs = {}
+    for slot, names in op.inputs.items():
+        specs[slot] = [env.get(n) or _declared_spec(block, n, batch)
+                       for n in names]
+    return specs
+
+
+def _out_specs(block, op, in_specs, env, batch):
+    """Output specs via memoized abstract re-inference, with declared
+    VarDesc shapes (batch-bound) as the fallback.  The propagation
+    environment only gains entries for outputs with NO usable
+    declaration — declared vars resolve through ``_declared_spec`` so
+    every op's input specs are reproducible without running its
+    producers (what keeps the prime batch's cache keys identical to
+    the walk's)."""
+    from ..core.infer import infer_outputs_cached
+    outs = None
+    try:
+        outs = infer_outputs_cached(op.type, in_specs, op.attrs,
+                                    list(op.outputs))
+    except Exception:
+        outs = None
+    specs = {}
+    for slot, names in op.outputs.items():
+        vals = []
+        inferred = (outs or {}).get(slot, [])
+        for i, n in enumerate(names):
+            s = inferred[i] if i < len(inferred) else None
+            declared = _declared_spec(block, n, batch)
+            if s is None:
+                s = declared
+            elif declared is None:
+                env[n] = s  # declaration-less output: propagate
+            vals.append(s)
+        specs[slot] = vals
+    return specs
+
+
+def _role(op):
+    return op.attrs.get('op_role', 'forward')
+
+
+def _autodiff_slice(ops, idx, loss_name):
+    """Indices of the forward-role ops before ``idx`` on the dependency
+    path INTO ``loss_name`` — the subgraph the backward pass actually
+    differentiates (metrics towers and other dead-to-the-loss forward
+    work carry no backward cost)."""
+    live = {loss_name}
+    picked = []
+    for j in range(idx - 1, -1, -1):
+        op = ops[j]
+        if op.type == 'autodiff' or _role(op) != 'forward':
+            continue
+        if set(op.output_arg_names) & live:
+            picked.append(j)
+            live.update(op.input_arg_names)
+    return picked
+
+
+def analyze_cost(program, fetch_names=(), feed_specs=None):
+    """Walk the (post-rewrite) global block and emit the cost report.
+
+    :param feed_specs: ``{name: (shape, dtype)}`` concrete feed shapes
+        from the executor (optional — without them, -1 batch dims fall
+        back to 1 and are tallied in ``coverage['unknown_dims']``).
+    :returns: report dict — ``per_op`` verdicts, ``per_role`` and
+        ``total`` FLOPs/bytes/intensity, feed/state byte totals, and a
+        ``coverage`` section naming every waived / no-verdict op type.
+    """
+    from ..core.infer import prime_infer_cache
+    block = program.global_block()
+    ops = block.ops
+    batch = _batch_binding(block, feed_specs)
+    env = {}
+    for n, (shape, dt) in (feed_specs or {}).items():
+        env[n] = (tuple(int(d) for d in shape), str(dt))
+
+    # batch the cold abstract evaluations into one trace (the verifier's
+    # prime pattern) — per-op eval_shape would pay ~ms each.  The specs
+    # here come from the SAME resolution the walk below uses (declared
+    # shapes with the -1 batch bound), so the walk's lookups hit the
+    # primed keys; only ops downstream of a declaration-less
+    # intermediate (env-propagated during the walk) can miss.
+    tasks = []
+    for op in ops:
+        if op.type == 'autodiff' or _structurally_waived(op) or \
+                op.type in WAIVED_OPS:
+            continue
+        tasks.append((op.type,
+                      _resolve_in_specs(block, op, env, batch),
+                      op.attrs, list(op.outputs)))
+    try:
+        prime_infer_cache(tasks)
+    except Exception:
+        pass  # per-op fallback below still works uncached
+
+    per_op = []
+    per_role = {}
+    waived = {}
+    no_verdict = []
+    unknown_dims = 0
+    costs_by_index = {}
+    for i, op in enumerate(ops):
+        if op.type == 'autodiff':
+            continue  # modeled from its slice below
+        if _structurally_waived(op):
+            waived[op.type] = 'control-flow/env/sub-block op: cost is ' \
+                              'its body\'s'
+            continue
+        if op.type in WAIVED_OPS:
+            waived[op.type] = WAIVED_OPS[op.type]
+            continue
+        in_specs = _resolve_in_specs(block, op, env, batch)
+        out_specs = _out_specs(block, op, in_specs, env, batch)
+        c = op_cost(op.type, in_specs, out_specs, op.attrs)
+        if c is None:
+            if op.type not in no_verdict:
+                no_verdict.append(op.type)
+            continue
+        unknown_dims += c.pop('unknown_dims')
+        entry = dict(c, index=i, type=op.type, role=_role(op))
+        costs_by_index[i] = entry
+        per_op.append(entry)
+        r = per_role.setdefault(entry['role'],
+                                {'flops': 0, 'bytes': 0})
+        r['flops'] += entry['flops']
+        r['bytes'] += entry['bytes']
+
+    # autodiff: 2x the loss-contributing forward slice (dgrad + wgrad)
+    for i, op in enumerate(ops):
+        if op.type != 'autodiff':
+            continue
+        sl = _autodiff_slice(ops, i, op.attrs.get('loss_name'))
+        flops = sum(costs_by_index[j]['flops'] for j in sl
+                    if j in costs_by_index)
+        nbytes = sum(costs_by_index[j]['bytes'] for j in sl
+                     if j in costs_by_index)
+        entry = {'index': i, 'type': 'autodiff', 'role': 'backward',
+                 'class': 'autodiff', 'macs': flops,  # 2x fwd MACs
+                 'flops': 2 * flops, 'bytes': 2 * nbytes,
+                 'fwd_slice_ops': len(sl)}
+        per_op.append(entry)
+        r = per_role.setdefault('backward', {'flops': 0, 'bytes': 0})
+        r['flops'] += entry['flops']
+        r['bytes'] += entry['bytes']
+
+    for r in per_role.values():
+        r['intensity'] = (r['flops'] / r['bytes']) if r['bytes'] else 0.0
+    total_flops = sum(r['flops'] for r in per_role.values())
+    total_bytes = sum(r['bytes'] for r in per_role.values())
+
+    unk = [0]
+    feed_bytes = None
+    if feed_specs:
+        feed_bytes = sum(
+            _spec_bytes((tuple(s), d), unk)
+            for s, d in feed_specs.values())
+    state_bytes = sum(
+        _spec_bytes((tuple(v.shape), v.dtype), unk)
+        for v in program.list_vars() if v.persistable and v.shape)
+
+    return {
+        'flops_basis': FLOPS_BASIS,
+        'per_op': per_op,
+        'per_role': per_role,
+        'total': {'flops': total_flops, 'bytes': total_bytes,
+                  'intensity': (total_flops / total_bytes)
+                               if total_bytes else 0.0},
+        'feed_bytes': feed_bytes,
+        'state_bytes': state_bytes,
+        'coverage': {
+            'ops': len(ops),
+            'modeled': len(per_op),
+            'waived': waived,
+            'no_verdict': no_verdict,
+            'unknown_dims': unknown_dims,
+        },
+    }

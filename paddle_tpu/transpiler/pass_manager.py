@@ -11,7 +11,10 @@ into an explicit pipeline:
 - every pass is **registered** (``@register_pass``) with a declared
   ``order``, a ``report_key``, and a kind (``rewrite`` | ``analysis``);
   tools/check_pass_registry.py statically audits the registry and
-  cross-checks it against the verifier mutation-test matrix.
+  cross-checks it against the verifier mutation-test matrix.  The
+  analysis tail is donation (order 90) then the static cost model
+  (order 95, transpiler/cost_model.py — after AMP so low-precision
+  bytes count).
 - ``run_pipeline`` builds the plan for the current configuration
   (graph-opt level, AMP mode), runs each pass on an isolated copy —a
   crashing pass is skipped with a per-pass report entry, it can no
@@ -84,11 +87,16 @@ class PassContext(object):
     names, and the protected/no-fold sets (computed once per pipeline,
     exactly like the PR-3 driver did)."""
 
-    def __init__(self, fetch_names, feed_names, pinned, amp_mode):
+    def __init__(self, fetch_names, feed_names, pinned, amp_mode,
+                 feed_specs=None):
         self.fetch_names = tuple(fetch_names)
         self.feed_names = tuple(feed_names)
         self.pinned = set(pinned)
         self.amp_mode = amp_mode
+        # {name: (shape, dtype)} concrete feed shapes from the executor
+        # — the cost-model pass seeds its shape propagation with them so
+        # -1 batch dims resolve to the real batch
+        self.feed_specs = dict(feed_specs or {})
         self.amp_report = None  # set by the amp pass
         self._protected = None
         self._no_fold = None
@@ -167,6 +175,17 @@ def _donation(program, ctx):
         program, ctx.fetch_names, ctx.feed_names)}
 
 
+@register_pass('cost_model', 95, 'cost', kind='analysis',
+               enabled=lambda cfg: cfg.level >= 1)
+def _cost_model(program, ctx):
+    # runs AFTER graph-opt and AMP on purpose: eliminated ops cost
+    # nothing and AMP-lowered values count their low-precision bytes
+    from . import cost_model
+    return {'cost': cost_model.analyze_cost(
+        program, fetch_names=ctx.fetch_names,
+        feed_specs=ctx.feed_specs)}
+
+
 # ---------------------------------------------------------------------------
 # plan building + the composite cache key
 # ---------------------------------------------------------------------------
@@ -216,7 +235,7 @@ _FROM_FLAG = object()
 
 def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
                  amp_mode=_FROM_FLAG, verify=_FROM_FLAG,
-                 extra_protected=()):
+                 extra_protected=(), feed_specs=None):
     """Run the registered pass plan over a copy of ``program``.
 
     Returns ``(program_out, report)``; the input program is never
@@ -258,7 +277,8 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
     t0 = time.perf_counter()
     pinned = set(extra_protected) | set(
         getattr(program, '_graph_opt_skip_set', None) or ())
-    ctx = PassContext(fetch_names, feed_names, pinned, amp_mode)
+    ctx = PassContext(fetch_names, feed_names, pinned, amp_mode,
+                      feed_specs=feed_specs)
 
     p = copy.deepcopy(program)
     passes._stamp_op_seq(p.global_block())
@@ -331,6 +351,8 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
             report['donation'] = frag['donation']
         if 'amp' in frag and frag['amp'] is not None:
             report['amp'] = frag['amp']
+        if frag.get('cost') is not None:
+            report['cost'] = frag['cost']
 
     if graph_opt_ran:
         report['ops_after'] = len(p.global_block().ops)

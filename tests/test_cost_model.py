@@ -1,0 +1,271 @@
+"""Static cost model (transpiler/cost_model.py): golden closed-form
+FLOPs/bytes for mnist-MLP, VGG-conv-block, and LSTM-cell programs, the
+autodiff backward-slice rule, the pass-manager/executor integration, and
+classification/waiver hygiene.
+
+Every golden value below is derived by hand from the program's shapes —
+the whole point of the model is that these numbers come from the IR, so
+a formula regression shows up as an exact mismatch, not a tolerance.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import registry
+from paddle_tpu.transpiler import cost_model
+
+
+def _role_flops(rep, role):
+    return rep['per_role'].get(role, {}).get('flops', 0)
+
+
+# -- golden: mnist MLP -----------------------------------------------------
+
+B = 32
+
+
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[784],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        h = fluid.layers.fc(input=img, size=128, act='relu')
+        pred = fluid.layers.fc(input=h, size=10, act='softmax')
+        loss = fluid.layers.mean(x=fluid.layers.cross_entropy(
+            input=pred, label=label))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, loss
+
+
+def test_mlp_golden_flops_and_bytes():
+    main, loss = _mlp_program()
+    rep = cost_model.analyze_cost(
+        main, fetch_names=(loss.name,),
+        feed_specs={'img': ((B, 784), 'float32'),
+                    'label': ((B, 1), 'int32')})
+    # forward FLOPs = 2 x (B*784*128 + B*128*10) MACs, exactly — the
+    # elementwise/softmax/loss ops are bytes-class and contribute 0
+    fwd_macs = B * 784 * 128 + B * 128 * 10
+    assert _role_flops(rep, 'forward') == 2 * fwd_macs
+    # every forward op feeds the loss here, so the backward slice is the
+    # whole forward: autodiff = 2 x forward
+    assert _role_flops(rep, 'backward') == 4 * fwd_macs
+    # optimizer is pure bytes (elementwise sgd): 0 FLOPs, nonzero bytes
+    assert _role_flops(rep, 'optimize') == 0
+    assert rep['per_role']['optimize']['bytes'] > 0
+    assert rep['total']['flops'] == 6 * fwd_macs
+    # per-op byte golden: relu reads+writes [B, 128] f32
+    relu = [e for e in rep['per_op'] if e['type'] == 'relu']
+    assert len(relu) == 1 and relu[0]['bytes'] == 2 * B * 128 * 4
+    # the first mul: X[B,784] + W[784,128] read, [B,128] written
+    mul0 = [e for e in rep['per_op'] if e['type'] == 'mul'][0]
+    assert mul0['macs'] == B * 784 * 128
+    assert mul0['bytes'] == 4 * (B * 784 + 784 * 128 + B * 128)
+    # feed bytes are exact; state bytes cover at least the four
+    # parameter tensors (the optimizer adds its own small persistables
+    # — learning-rate scalars & co — on top)
+    assert rep['feed_bytes'] == B * 784 * 4 + B * 1 * 4
+    params = 4 * (784 * 128 + 128 + 128 * 10 + 10)
+    assert params <= rep['state_bytes'] <= params + 4096
+    # full coverage: no silent zeros on this program
+    cov = rep['coverage']
+    assert cov['no_verdict'] == [] and cov['unknown_dims'] == 0
+    assert cov['modeled'] == cov['ops']
+
+
+def test_mlp_metrics_tower_not_in_backward_slice():
+    """An accuracy tower rides the forward but feeds no gradient — the
+    autodiff cost must cover only the loss-contributing slice (the old
+    hand rule train=3xfwd charged it 3x)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[16],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='int64')
+        pred = fluid.layers.fc(input=img, size=10, act='softmax')
+        # dead-to-the-loss tower: an extra matmul head feeding accuracy
+        side = fluid.layers.fc(input=img, size=10, act='softmax')
+        acc = fluid.layers.accuracy(input=side, label=label)
+        loss = fluid.layers.mean(x=fluid.layers.cross_entropy(
+            input=pred, label=label))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    rep = cost_model.analyze_cost(
+        main, fetch_names=(loss.name, acc.name),
+        feed_specs={'img': ((B, 16), 'float32'),
+                    'label': ((B, 1), 'int32')})
+    # forward counts BOTH heads...
+    assert _role_flops(rep, 'forward') == 2 * (2 * B * 16 * 10)
+    # ...backward counts only the loss head, twice
+    assert _role_flops(rep, 'backward') == 2 * (2 * B * 16 * 10)
+
+
+# -- golden: VGG conv block ------------------------------------------------
+
+def test_vgg_conv_block_golden():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        c1 = fluid.layers.conv2d(input=img, num_filters=64,
+                                 filter_size=3, padding=1, act='relu')
+        c2 = fluid.layers.conv2d(input=c1, num_filters=64,
+                                 filter_size=3, padding=1, act='relu')
+        p = fluid.layers.pool2d(input=c2, pool_size=2, pool_stride=2,
+                                pool_type='max')
+        loss = fluid.layers.mean(x=p)
+    b = 8
+    rep = cost_model.analyze_cost(
+        main, fetch_names=(loss.name,),
+        feed_specs={'img': ((b, 3, 32, 32), 'float32')})
+    # conv MACs = out_elements x (Cin/groups x kh x kw), same-padding
+    # keeps 32x32 spatial
+    conv1 = b * 64 * 32 * 32 * (3 * 3 * 3)
+    conv2 = b * 64 * 32 * 32 * (64 * 3 * 3)
+    assert _role_flops(rep, 'forward') == 2 * (conv1 + conv2)
+    # pooling/relu/bias are bytes-class: the conv ops are the only MACs
+    mac_ops = [e for e in rep['per_op'] if e['class'] == 'mac']
+    assert sorted(e['macs'] for e in mac_ops) == sorted([conv1, conv2])
+    assert rep['coverage']['no_verdict'] == []
+    assert rep['coverage']['unknown_dims'] == 0
+
+
+# -- golden: LSTM cell -----------------------------------------------------
+
+def test_lstm_cell_golden():
+    t_len, d, h = 5, 16, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[t_len, d],
+                              dtype='float32')
+        proj = fluid.layers.fc(input=x, size=4 * h, num_flatten_dims=2)
+        hid, _cell = fluid.layers.dynamic_lstm(input=proj, size=4 * h)
+        loss = fluid.layers.mean(x=hid)
+    b = 4
+    rep = cost_model.analyze_cost(
+        main, fetch_names=(loss.name,),
+        feed_specs={'x': ((b, t_len, d), 'float32')})
+    # gate projection: [B*T, D] x [D, 4H]; recurrence: per step
+    # [B, H] x [H, 4H] over T steps = prod(Input) * H
+    proj_macs = b * t_len * d * 4 * h
+    lstm_macs = b * t_len * 4 * h * h
+    assert _role_flops(rep, 'forward') == 2 * (proj_macs + lstm_macs)
+    lstm_ops = [e for e in rep['per_op'] if e['type'] == 'lstm']
+    assert len(lstm_ops) == 1
+    assert lstm_ops[0]['macs'] == lstm_macs
+    assert lstm_ops[0]['bytes'] > 0
+    assert rep['coverage']['unknown_dims'] == 0
+
+
+# -- the executor/pass-manager join ----------------------------------------
+
+def test_cost_report_reaches_executor_report():
+    """The registered cost_model pass runs per plan build with the
+    executor's concrete feed specs, lands in last_graph_opt_report, and
+    is served back on plan-cache hits."""
+    scope = fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        feed = {'img': np.zeros((B, 784), np.float32),
+                'label': np.zeros((B, 1), np.int32)}
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            img = fluid.layers.data(name='img', shape=[784],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            h = fluid.layers.fc(input=img, size=128, act='relu')
+            pred = fluid.layers.fc(input=h, size=10, act='softmax')
+            l = fluid.layers.mean(x=fluid.layers.cross_entropy(
+                input=pred, label=label))
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(l)
+        exe2.run(s)
+        exe2.run(m, feed=feed, fetch_list=[l])
+        rep = exe2.last_graph_opt_report
+        assert rep is not None and 'cost' in rep
+        cost = rep['cost']
+        fwd_macs = B * 784 * 128 + B * 128 * 10
+        # the executor's feed specs resolved the -1 batch: exact totals
+        assert cost['per_role']['forward']['flops'] == 2 * fwd_macs
+        assert cost['total']['flops'] == 6 * fwd_macs
+        assert cost['coverage']['unknown_dims'] == 0
+        # cache hit restores the same report object
+        exe2.run(m, feed=feed, fetch_list=[l])
+        assert exe2.last_graph_opt_report['cost'] is cost
+        # and the per-pass report names the analysis pass
+        names = [e['name'] for e in rep['passes']]
+        assert 'cost_model' in names
+
+
+def test_cost_pass_respects_level_zero(monkeypatch):
+    """Graph-opt level 0 disables the analysis passes (the legacy
+    bypass contract): no cost report, and bench.py's documented hand
+    fallback path is what remains."""
+    monkeypatch.setenv('PADDLE_TPU_GRAPH_OPT_LEVEL', '0')
+    from paddle_tpu.transpiler import pass_manager as pm
+    main, loss = _mlp_program()
+    _out, rep = pm.run_pipeline(main, fetch_names=(loss.name,),
+                                feed_names=('img', 'label'))
+    assert 'cost' not in rep
+
+
+# -- classification / waiver hygiene ---------------------------------------
+# (the every-registered-op verdict-or-waiver sweep lives in
+# tests/test_zz_op_coverage.py with the other registry sweeps)
+
+def test_cost_and_amp_mac_sets_stay_equal():
+    """COST_MAC is deliberately the AMP white set — one 'FLOPs land on
+    the MXU' property, two consumers.  If they ever diverge, this
+    forces the divergence to be explicit."""
+    assert registry.COST_MAC == registry.AMP_WHITE
+
+
+def test_analyze_cost_survives_every_registered_op():
+    """Sweep: a signature-conformant single-op program per registered
+    op type through analyze_cost.  No op may crash it, and every op
+    lands in exactly one bucket (modeled / waived / no-verdict)."""
+    from tests.test_zz_op_coverage import _sweep_program
+    for t in registry.registered_ops():
+        p, fetches, _feeds = _sweep_program(t)
+        rep = cost_model.analyze_cost(p, fetch_names=fetches)
+        cov = rep['coverage']
+        modeled_types = {e['type'] for e in rep['per_op']}
+        buckets = ((t in modeled_types) + (t in cov['waived'])
+                   + (t in cov['no_verdict']))
+        assert buckets == 1, (
+            "op %r landed in %d cost buckets (modeled=%s waived=%s "
+            "no_verdict=%s)" % (t, buckets, t in modeled_types,
+                                t in cov['waived'],
+                                t in cov['no_verdict']))
+
+
+def test_bf16_program_counts_low_precision_bytes():
+    """The pass runs after AMP on purpose: a bf16-lowered matmul's
+    bytes column must count 2-byte activations (the bandwidth half of
+    the AMP win is visible in the model)."""
+    from paddle_tpu.transpiler import pass_manager as pm
+    main, loss = _mlp_program()
+    feed_specs = {'img': ((B, 784), 'float32'),
+                  'label': ((B, 1), 'int32')}
+    _o1, rep_f32 = pm.run_pipeline(
+        main, fetch_names=(loss.name,), feed_names=('img', 'label'),
+        level=2, amp_mode='0', verify='off', feed_specs=feed_specs)
+    _o2, rep_bf16 = pm.run_pipeline(
+        main, fetch_names=(loss.name,), feed_names=('img', 'label'),
+        level=2, amp_mode='bf16', verify='off', feed_specs=feed_specs)
+    # FLOPs are precision-invariant
+    assert rep_bf16['cost']['total']['flops'] == \
+        rep_f32['cost']['total']['flops']
+    # the matmuls' operand traffic halves (bf16 activations) — that is
+    # the bandwidth half of the AMP win, visible per op.  (Whole-program
+    # bytes do NOT shrink: the inserted casts honestly count the extra
+    # copies they move.)
+    muls_f32 = sorted(e['bytes'] for e in rep_f32['cost']['per_op']
+                      if e['type'] == 'mul')
+    muls_bf16 = sorted(e['bytes'] for e in rep_bf16['cost']['per_op']
+                       if e['type'] == 'mul')
+    assert len(muls_f32) == len(muls_bf16) == 2
+    for lo, hi in zip(muls_bf16, muls_f32):
+        assert lo < hi

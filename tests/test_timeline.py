@@ -1,0 +1,276 @@
+"""Step-timeline flight recorder (observability/timeline.py) and its
+executor join (Executor.last_step_report): ring semantics, Chrome trace
+export, trace-dir flush, dump-on-error forensics, profiler rebase onto
+the shared ring, and the phase-report contract.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.observability import timeline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    timeline.reset()
+    yield
+    timeline.reset()
+
+
+# -- ring semantics --------------------------------------------------------
+
+def test_ring_records_and_bounds():
+    tl = timeline.Timeline(cap=4)
+    for i in range(10):
+        tl.record('ev%d' % i, cat='user', dur=0.001, step=i)
+    evs = tl.events()
+    assert len(evs) == 4
+    assert [e['name'] for e in evs] == ['ev6', 'ev7', 'ev8', 'ev9']
+    assert all(e['dur'] == 0.001 for e in evs)
+
+
+def test_ring_category_and_step_filters():
+    tl = timeline.Timeline(cap=None)
+    for s in range(6):
+        tl.set_step(s)
+        tl.record('feed', cat='feed')
+        tl.record('user', cat='user')
+    assert len(tl.events(cat='feed')) == 6
+    last2 = tl.events(last_steps=2)
+    assert {e['step'] for e in last2} == {4, 5}
+
+
+def test_chrome_trace_export_is_loadable(tmp_path):
+    tl = timeline.Timeline(cap=None)
+    tl.set_step(3)
+    tl.record('executor.dispatch', cat='compute', dur=0.5,
+              args={'k': 8})
+    path = tl.export_chrome_trace(str(tmp_path / 'trace.json'))
+    doc = json.load(open(path))
+    assert 'traceEvents' in doc
+    evs = doc['traceEvents']
+    # metadata process_name + the one X event
+    assert evs[0]['ph'] == 'M'
+    x = [e for e in evs if e['ph'] == 'X']
+    assert len(x) == 1
+    assert x[0]['name'] == 'executor.dispatch'
+    assert x[0]['cat'] == 'compute'
+    assert x[0]['dur'] == pytest.approx(0.5e6)
+    assert x[0]['args']['step'] == 3
+    assert x[0]['args']['k'] == 8
+    assert isinstance(x[0]['ts'], float) and isinstance(x[0]['pid'], int)
+
+
+def test_disarmed_is_nullpath(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_TRACE_DIR', raising=False)
+    monkeypatch.delenv('PADDLE_TPU_TRACE_DUMP_ON_ERROR', raising=False)
+    timeline.reload_armed()
+    assert timeline.armed() is False
+    assert timeline.ring_if_armed() is None
+    assert timeline.maybe_flush() is None
+    assert timeline.maybe_dump_on_error() is None
+
+
+def test_armed_cache_reloads(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_TRACE_DIR', raising=False)
+    timeline.reload_armed()
+    assert not timeline.armed()
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', '/tmp/x')
+    assert not timeline.armed()  # cached until reload
+    timeline.reload_armed()
+    assert timeline.armed()
+
+
+# -- profiler rebase (satellite: ONE event buffer) -------------------------
+
+def test_record_event_lands_on_shared_ring():
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    with profiler.RecordEvent('shared_ring_probe'):
+        pass
+    names = [e['name'] for e in timeline.ring().events(cat='user')]
+    assert 'shared_ring_probe' in names
+    # and the legacy tuple view agrees
+    evs = profiler.get_events()
+    assert any(n == 'shared_ring_probe' and d >= 0.0 for n, d in evs)
+
+
+def test_get_events_excludes_executor_categories():
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    timeline.record('executor.dispatch', cat='compute', dur=0.1)
+    with profiler.RecordEvent('mine'):
+        pass
+    assert [n for n, _d in profiler.get_events()] == ['mine']
+
+
+def test_reset_profiler_clears_shared_ring():
+    from paddle_tpu import profiler
+    timeline.record('stale', cat='compute', dur=0.1)
+    with profiler.RecordEvent('stale_user'):
+        pass
+    profiler.reset_profiler()
+    assert profiler.get_events() == []
+    assert timeline.ring().events() == []
+
+
+# -- executor join ---------------------------------------------------------
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        p = fluid.layers.fc(input=x, size=8)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(k, b=4):
+    rng = np.random.default_rng(0)
+    return [{'x': rng.normal(size=(b, 16)).astype(np.float32),
+             'y': rng.normal(size=(b, 1)).astype(np.float32)}
+            for _ in range(k)]
+
+
+def _run_steps(k=3, scope=None):
+    scope = scope or fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, loss = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run_steps(main, feed=_feeds(k), fetch_list=[loss])
+    return exe
+
+
+def test_last_step_report_phases_sum_to_wall():
+    exe = _run_steps(k=3)
+    rep = exe.last_step_report
+    assert rep['k'] == 3
+    # the three phase walls are exactly the wall by construction
+    # (compute is the residual)
+    assert rep['feed_s'] + rep['compute_s'] + rep['update_s'] == \
+        pytest.approx(rep['wall_s'])
+    ph = rep['phases']
+    assert set(ph) == {'feed', 'compute', 'update'}
+    assert ph['feed']['wall_s'] == rep['feed_s']
+    assert ph['compute']['wall_s'] == rep['compute_s']
+    assert ph['update']['wall_s'] == rep['update_s']
+    # each phase is annotated with modeled bytes/FLOPs from the cost
+    # model (default graph-opt level runs the cost pass)
+    assert ph['feed']['bytes'] > 0
+    assert ph['feed']['modeled_bytes_per_step'] == 4 * (16 + 1) * 4
+    assert ph['compute']['flops_per_step'] > 0
+    assert ph['compute']['bytes_per_step'] > 0
+    assert ph['update']['state_bytes'] > 0
+    # fwd mul = 4x16x8 MACs; bwd = 2x fwd
+    fwd = ph['compute']['per_role_flops']['forward']
+    assert fwd == 2 * 4 * 16 * 8
+    assert ph['compute']['per_role_flops']['backward'] == 2 * fwd
+    # deprecated alias still serves the same dict
+    assert exe.last_run_steps_report is rep
+
+
+def test_last_step_report_mfu_with_peak(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_PEAK_TFLOPS', '0.001')
+    exe = _run_steps(k=2)
+    rep = exe.last_step_report
+    assert rep['synced'] is True
+    comp = rep['phases']['compute']
+    assert comp['mfu'] == pytest.approx(
+        comp['flops_per_s'] / 1e9)
+
+
+def test_unsynced_call_publishes_no_rate(monkeypatch):
+    """return_numpy=False returns before the device finishes: the
+    residual measures host dispatch only, so the report must carry the
+    modeled FLOPs but NO achieved-rate/MFU fields (a rate from an
+    unsynced window would overstate MFU by device-time/dispatch-time;
+    externally-syncing callers like benchmarks/common.py derive MFU
+    from their own synced wall)."""
+    monkeypatch.setenv('PADDLE_TPU_PEAK_TFLOPS', '0.001')
+    scope = fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, loss = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run_steps(main, feed=_feeds(2), fetch_list=[loss],
+                      return_numpy=False)
+    rep = exe.last_step_report
+    assert rep['synced'] is False
+    comp = rep['phases']['compute']
+    assert comp['flops_per_step'] > 0  # model still attached
+    assert 'flops_per_s' not in comp and 'mfu' not in comp
+
+
+def test_run_steps_flushes_chrome_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    timeline.reload_armed()
+    _run_steps(k=3)
+    files = [f for f in os.listdir(str(tmp_path))
+             if f.endswith('.json')]
+    assert files, 'no trace exported'
+    doc = json.load(open(str(tmp_path / files[0])))
+    names = {e['name'] for e in doc['traceEvents']
+             if e.get('ph') == 'X'}
+    # the per-step phases the flight recorder exists to attribute
+    assert 'executor.feed_stack' in names
+    assert 'executor.compile' in names
+    assert 'executor.scope_update' in names
+    assert 'executor.fetch_sync' in names
+    # events are step-tagged for the last-N-steps window
+    steps = {e['args'].get('step') for e in doc['traceEvents']
+             if e.get('ph') == 'X'}
+    assert any(isinstance(s, int) for s in steps)
+
+
+def test_prefetch_path_emits_stage_events(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH', '1')
+    monkeypatch.setenv('PADDLE_TPU_DEVICE_PREFETCH_CHUNK', '2')
+    timeline.reload_armed()
+    exe = _run_steps(k=4)
+    assert exe.last_step_report['chunks'] == 2
+    evs = timeline.ring().events(cat='feed')
+    stage = [e for e in evs if e['name'] == 'prefetch.stage']
+    assert len(stage) >= 2
+    assert stage[0]['args']['primed'] is True
+    assert all(e['args']['primed'] is False for e in stage[1:])
+
+
+def test_dump_on_error_writes_forensics_file(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DUMP_ON_ERROR', '1')
+    timeline.reload_armed()
+    scope = fluid.core.scope.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, loss = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(Exception):
+            # wrong feed column set: fails inside run_steps
+            exe.run_steps(main, feed=[{'x': np.zeros((4, 16),
+                                                     np.float32)}],
+                          fetch_list=[loss])
+    err = [f for f in os.listdir(str(tmp_path)) if '_error' in f]
+    assert err, 'dump-on-error file missing'
+    doc = json.load(open(str(tmp_path / err[0])))
+    assert 'traceEvents' in doc
+
+
+def test_disarmed_executor_records_nothing(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_TRACE_DIR', raising=False)
+    monkeypatch.delenv('PADDLE_TPU_TRACE_DUMP_ON_ERROR',
+                       raising=False)
+    timeline.reload_armed()
+    _run_steps(k=2)
+    # no executor-phase events land on the ring when disarmed (spans
+    # and RecordEvents are the only unconditional producers)
+    cats = {e['cat'] for e in timeline.ring().events()}
+    assert 'feed' not in cats and 'compute' not in cats \
+        and 'update' not in cats

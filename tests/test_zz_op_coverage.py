@@ -31,7 +31,8 @@ def test_graph_opt_classification_consistent_with_registry():
     from paddle_tpu.transpiler import passes
 
     for t in registry.registered_ops():
-        registered, stateful_rng, needs_env, _amp = registry.op_traits(t)
+        registered, stateful_rng, needs_env, _amp, _cost = \
+            registry.op_traits(t)
         assert registered
         if needs_env:
             assert t in passes.EFFECTFUL_OPS, (
@@ -169,6 +170,32 @@ def _sweep_program(t):
                                attrs=attrs)
     fetches = [n for ns in outputs.values() for n in ns]
     return p, tuple(fetches), tuple(feeds)
+
+
+def test_cost_model_verdict_or_waiver_for_every_registered_op():
+    """Sweep: every registered op yields a cost verdict path or an
+    explicit commented waiver (transpiler/cost_model.py).  'mac'-class
+    ops must carry a closed-form MAC formula (a COST_MAC entry without
+    one would silently cost 0); everything else is bytes-class;
+    WAIVED_OPS entries must name real ops so waiver rot is caught."""
+    from paddle_tpu.transpiler import cost_model
+
+    for t in registry.registered_ops():
+        traits = registry.op_traits(t)
+        assert traits.cost == registry.cost_class(t)
+        assert traits.cost in ('mac', 'bytes')
+        assert (traits.cost == 'mac') == (t in registry.COST_MAC)
+        if traits.cost == 'mac' and t not in cost_model.WAIVED_OPS:
+            assert t in cost_model.MAC_FORMULAS, (
+                "COST_MAC op %r has no MAC formula and no waiver — it "
+                "would cost 0 silently" % t)
+    # formulas only name mac-class ops (one for a bytes op never runs)
+    assert set(cost_model.MAC_FORMULAS) <= set(registry.COST_MAC)
+    # waivers name real ops (autodiff is the one pseudo-op the
+    # executor interprets without registration)
+    for t in cost_model.WAIVED_OPS:
+        assert t == 'autodiff' or registry.has_op(t), (
+            "WAIVED_OPS entry %r does not name a registered op" % t)
 
 
 def test_verifier_every_pass_over_every_registered_op():

@@ -1,0 +1,145 @@
+"""Static consistency check for observability metric names.
+
+Walks every ``paddle_tpu/**/*.py`` AST for literal-named registrations —
+``<registry>.counter('name', ...)`` / ``.gauge(...)`` / ``.histogram(...)``
+— and enforces the naming convention the exposition contract relies on:
+
+- every metric name starts with ``paddle_tpu_`` (one namespace, no
+  collisions with whatever else the scrape target exports);
+- counters end in ``_total`` (the Prometheus counter convention scrape
+  rules and dashboards key on);
+- histograms carry a unit suffix, ``_seconds`` or ``_bytes`` (a latency
+  histogram named without its unit is a dashboard mislabel waiting to
+  happen) — dimensionless distributions need an explicit waiver below;
+- every metric appears in README.md's metrics table, so the name ships
+  documented, not diff-only (the same drift guard check_flags_doc.py
+  applies to flags).
+
+Runs standalone (``python tools/check_metric_names.py``, exit 1 on
+failure) and in tier-1 via tests/test_metric_names.py, which imports
+``check()`` so CI pays no extra interpreter start.
+"""
+import ast
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+PREFIX = 'paddle_tpu_'
+_KINDS = {'counter', 'gauge', 'histogram'}
+HISTOGRAM_UNITS = ('_seconds', '_bytes')
+
+# Metric names exempt from one rule, each with the reason.  Keep short:
+# a waiver is a debt note, not a second convention.
+WAIVERS = {
+    # rows-per-batch distribution: dimensionless by design (occupancy),
+    # and the name is load-bearing — BatchingInferenceServer.stats()
+    # and the serving benches read it back by name
+    'paddle_tpu_serving_batch_occupancy': 'histogram unit suffix',
+}
+
+
+def _registrations():
+    """[(name, kind, relpath, lineno)] for every literal-named metric
+    registration under paddle_tpu/."""
+    found = []
+    pkg = os.path.join(_REPO, 'paddle_tpu')
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        if '__pycache__' in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if not fn.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, _REPO)
+            # the registry/factory layer itself passes names through
+            # variables; its defs are not registration SITES
+            if rel.replace(os.sep, '/') == \
+                    'paddle_tpu/observability/metrics.py':
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError as e:
+                    found.append((None, 'parse-error', rel, e.lineno))
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr in _KINDS):
+                    continue
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    found.append((arg.value, func.attr, rel,
+                                  node.lineno))
+    return found
+
+
+def check():
+    """Returns a list of human-readable error strings (empty = OK)."""
+    errors = []
+    regs = _registrations()
+    if not any(name for name, _k, _f, _l in regs):
+        return ["no metric registrations found under paddle_tpu/ — "
+                "AST walk bug?"]
+    try:
+        with open(os.path.join(_REPO, 'README.md')) as f:
+            readme = f.read()
+    except OSError as e:
+        return ["cannot read README.md: %s" % e]
+
+    seen = set()
+    for name, kind, rel, lineno in regs:
+        where = "%s:%s" % (rel, lineno)
+        if kind == 'parse-error':
+            errors.append("%s: file does not parse" % where)
+            continue
+        if not name.startswith(PREFIX):
+            errors.append(
+                "%s: metric %r must start with %r (one exported "
+                "namespace)" % (where, name, PREFIX))
+        if kind == 'counter' and not name.endswith('_total'):
+            errors.append(
+                "%s: counter %r must end in '_total' (Prometheus "
+                "counter convention)" % (where, name))
+        if kind == 'histogram' and \
+                not name.endswith(HISTOGRAM_UNITS) and \
+                WAIVERS.get(name) != 'histogram unit suffix':
+            errors.append(
+                "%s: histogram %r must carry a unit suffix %s (or an "
+                "explicit WAIVERS entry)" % (where, name,
+                                             list(HISTOGRAM_UNITS)))
+        if name not in seen and name not in readme:
+            errors.append(
+                "%s: metric %r is not documented in README.md (add a "
+                "row to the metrics table)" % (where, name))
+        seen.add(name)
+
+    for name in sorted(WAIVERS):
+        if name not in seen:
+            errors.append(
+                "WAIVERS entry %r does not match any registered "
+                "metric (renamed or removed?)" % name)
+    return errors
+
+
+def main():
+    errors = check()
+    for e in errors:
+        print("check_metric_names: %s" % e, file=sys.stderr)
+    if errors:
+        return 1
+    names = {n for n, _k, _f, _l in _registrations() if n}
+    print("check_metric_names: OK (%d metric names conform and are "
+          "documented in README)" % len(names))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
