@@ -145,6 +145,28 @@ def _step_breakdown(exe, program, loss, feed_fn, k=None, chunk=2):
                         comp['flops_per_step'] /
                         (row_compute_s * float(peak) * 1e12), 4)
                 rows[label]['modeled'] = modeled
+            # memory block (Executor.last_step_report['memory']): the
+            # liveness model's peak next to the MEASURED device peak
+            # when the backend reports memory_stats() — None on CPU,
+            # stated rather than faked — plus the watermark op, so
+            # PERF.md can print modeled-vs-measured deltas per bench
+            mem = rep.get('memory') or {}
+            if mem:
+                wm = mem.get('watermark_op') or {}
+                mrow = {
+                    'modeled_peak_bytes': mem.get('modeled_peak_bytes'),
+                    'measured_peak_bytes':
+                        (mem.get('measured') or {}).get(
+                            'peak_bytes_in_use'),
+                    'watermark_op': wm.get('type'),
+                    'watermark_op_seq': wm.get('op_seq'),
+                }
+                head = mem.get('headroom')
+                if head:
+                    mrow['headroom'] = {
+                        k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in head.items()}
+                rows[label]['memory'] = mrow
     finally:
         for n in keys:
             if saved[n] is None:

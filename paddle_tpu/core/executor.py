@@ -803,6 +803,10 @@ class Executor(object):
                           dur=time.perf_counter() - t_d0,
                           args={'donated_state_bytes':
                                 _nbytes(state_rw)})
+                ms = _tlm.device_memory_stats(self._memory_device())
+                if ms and ms.get('bytes_in_use') is not None:
+                    tl.counter_sample('paddle_tpu.device_bytes_in_use',
+                                      ms['bytes_in_use'])
             for n, v in new_state.items():
                 scope.set(n, v)
             if return_numpy:
@@ -1429,6 +1433,15 @@ class Executor(object):
                         em.steps.inc(hi - done)
                     done = hi
                     ys_parts.append(ys)
+                    if tl0 is not None:
+                        # measured device memory, one sample per chunk
+                        # (None on backends without memory_stats)
+                        ms = _tlm.device_memory_stats(
+                            self._memory_device())
+                        if ms and ms.get('bytes_in_use') is not None:
+                            tl0.counter_sample(
+                                'paddle_tpu.device_bytes_in_use',
+                                ms['bytes_in_use'])
             except BaseException as e:
                 # BaseException: a Ctrl-C during the seconds-wide
                 # multi-chunk host loop must land the boundary state
@@ -1534,7 +1547,7 @@ class Executor(object):
                       'bytes': report.get('feed_bytes', 0)}
         compute_phase = {'wall_s': compute}
         update_phase = {'wall_s': report['update_s']}
-        if cost is not None:
+        if cost is not None and cost.get('total') is not None:
             total = cost['total']
             compute_phase.update({
                 'flops': total['flops'] * k,
@@ -1560,8 +1573,91 @@ class Executor(object):
                             'compute': compute_phase,
                             'update': update_phase}
         report['cost'] = cost
+        measured = _tlm.device_memory_stats(self._memory_device())
+        report['memory'] = self._memory_report(cost, measured)
+        tl = _tlm.ring_if_armed()
+        if tl is not None:
+            self._emit_memory_counters(
+                tl, (cost or {}).get('memory'),
+                t_call + report['feed_s'], compute, measured=measured)
         _tlm.maybe_flush()
         return report
+
+    def _memory_device(self):
+        """The device whose memory_stats() this executor's measured
+        numbers describe — the executor's PLACE, not local_devices()[0]
+        (on a multi-device host they differ, and the modeled-vs-
+        measured comparison must read one device)."""
+        try:
+            return self.place.jax_device()
+        except Exception:
+            return None
+
+    def _memory_report(self, cost, measured):
+        """The memory block of ``last_step_report``: the modeled peak
+        (liveness walk, transpiler/memory_model.py) joined with the
+        MEASURED device stats when the backend provides them —
+        ``measured`` is honestly None on CPU backends, never a made-up
+        zero — plus a headroom ratio against PADDLE_TPU_PEAK_HBM_BYTES
+        when set, so model-vs-measured divergence is a first-class
+        printed quantity."""
+        from ..flags import FLAGS
+        mem = (cost or {}).get('memory') if isinstance(cost, dict) \
+            else None
+        entry = {
+            'modeled_peak_bytes': (mem or {}).get('peak_bytes'),
+            'modeled_persistable_bytes':
+                (mem or {}).get('persistable_bytes'),
+            'watermark_op': ((mem or {}).get('watermark') or [None])[0],
+            'remat_level': (mem or {}).get('remat_level'),
+            'measured': measured,
+        }
+        if measured is not None:
+            entry['measured_peak_bytes'] = measured.get(
+                'peak_bytes_in_use')
+        budget = int(FLAGS.peak_hbm_bytes or 0)
+        if budget > 0:
+            head = {'budget_bytes': budget}
+            if entry['modeled_peak_bytes']:
+                head['modeled_ratio'] = (
+                    entry['modeled_peak_bytes'] / budget)
+            if measured is not None and \
+                    measured.get('peak_bytes_in_use'):
+                head['measured_ratio'] = (
+                    measured['peak_bytes_in_use'] / budget)
+            entry['headroom'] = head
+        return entry
+
+    @staticmethod
+    def _emit_memory_counters(tl, mem, t0, span, measured=None):
+        """Render the modeled live-bytes sawtooth as a Chrome counter
+        track (``ph:"C"``): samples step along op_seq, mapped linearly
+        onto the measured compute window so the track lines up with the
+        dispatch it models.  Downsampled to a bounded point count with
+        the peak sample always kept — a 1000-op program must not eat
+        the event ring.  ``measured`` is the device_memory_stats()
+        dict the caller already captured (one query serves both the
+        report and the counter track), sampled alongside."""
+        timeline = (mem or {}).get('timeline') or ()
+        if timeline:
+            pts = list(timeline)
+            cap = 96
+            if len(pts) > cap:
+                peak_i = max(range(len(pts)),
+                             key=lambda i: pts[i]['live_bytes'])
+                stride = -(-len(pts) // cap)
+                keep = sorted({0, peak_i, len(pts) - 1}
+                              | set(range(0, len(pts), stride)))
+                pts = [pts[i] for i in keep]
+            span = max(span, 1e-6)
+            n = max(len(pts) - 1, 1)
+            for i, p in enumerate(pts):
+                tl.counter_sample('paddle_tpu.modeled_live_bytes',
+                                  p['live_bytes'],
+                                  t0=t0 + span * (i / n))
+        if measured and measured.get('bytes_in_use') is not None:
+            tl.counter_sample('paddle_tpu.device_bytes_in_use',
+                              measured['bytes_in_use'])
 
     def _compile_common(self, program, feed, fetch_list, scope):
         if program is None:

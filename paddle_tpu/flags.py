@@ -248,6 +248,16 @@ DEFINE_bool('trace_dump_on_error', False,
             're-raising — a long run that dies at step 40k leaves its '
             'final timeline behind.  Arming this also arms timeline '
             'recording even without a trace dir')
+DEFINE_int('peak_hbm_bytes', 0,
+           'device HBM capacity in bytes for headroom accounting: when '
+           '>0, Executor.last_step_report["memory"] adds a headroom '
+           'block (modeled and measured peak as a ratio of this '
+           'budget), and inference.ServingFleet uses it as the default '
+           'hbm_budget_bytes for the deploy() warn-only resident-bytes '
+           'precheck.  0 (default) disables both — the memory model '
+           'still reports absolute bytes either way.  Set it to the '
+           'chip HBM size (e.g. 16 GiB for a v5e core) minus whatever '
+           'reserve the runtime claims')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

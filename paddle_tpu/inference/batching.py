@@ -56,6 +56,7 @@ import jax
 
 from .. import observability as _obs
 from ..core.executor import _maybe_enable_compilation_cache
+from ..observability import timeline as _tlm
 from .serving import InferenceServer, export_inference
 
 __all__ = ['BatchingInferenceServer', 'export_bucketed', 'bucket_sizes']
@@ -218,13 +219,14 @@ def export_bucketed(dir_path, feed_specs, target_vars, executor=None,
 
 
 class _Request(object):
-    __slots__ = ('feed', 'rows', 'future', 't_submit')
+    __slots__ = ('feed', 'rows', 'future', 't_submit', 'rid')
 
-    def __init__(self, feed, rows, t_submit):
+    def __init__(self, feed, rows, t_submit, rid):
         self.feed = feed
         self.rows = rows
         self.future = Future()
         self.t_submit = t_submit
+        self.rid = rid
 
 
 class BatchingInferenceServer(object):
@@ -282,6 +284,7 @@ class BatchingInferenceServer(object):
             # the same dict object, deliberately: a bucket lazily
             # compiled by either sibling is visible to both
             self._compiled = src._compiled
+            self._bucket_paths = dict(src._bucket_paths)
             self._buckets = src._buckets
             self.max_batch = src.max_batch
             self._feed_names = src._feed_names
@@ -293,6 +296,8 @@ class BatchingInferenceServer(object):
             self._servers = {int(b): InferenceServer(p)
                              for b, p in bucket_paths.items()}
             self._compiled = {}
+            self._bucket_paths = {int(b): p
+                                  for b, p in bucket_paths.items()}
             self._buckets = sorted(self._servers)
             self.max_batch = self._buckets[-1]
             avals = self._servers[self.max_batch].feed_avals()
@@ -347,6 +352,9 @@ class BatchingInferenceServer(object):
         reg = _obs.registry() if _obs.enabled() \
             else _obs.MetricsRegistry()
         self._m = _ServingMetrics(reg, sid)
+        # monotonic per-server request ids for the timeline dispatch
+        # spans (a fleet passes its own fleet-level id through submit)
+        self._req_seq = itertools.count()
         self._warmup_done = False
         self._closed = False
         self._owned_dir = None  # set by from_program when it mkdtemp'd
@@ -398,15 +406,21 @@ class BatchingInferenceServer(object):
         return srv
 
     # -- client surface ------------------------------------------------
-    def submit(self, feed):
+    def submit(self, feed, request_id=None):
         """Enqueue one request; returns a Future of [output arrays],
         each keeping the request's leading row count.  Blocks only when
         the request queue is full (backpressure).  After :meth:`drain`
         or :meth:`close` this raises ``RuntimeError`` immediately — a
         request must never enqueue behind a dispatcher that is retiring
-        (its Future would hang the caller forever)."""
+        (its Future would hang the caller forever).
+
+        ``request_id`` threads an upstream id (the fleet dispatcher's)
+        through the dispatch spans in the flight-recorder timeline; by
+        default each request gets this server's next monotonic id."""
         norm, rows = self._normalize(feed)
-        req = _Request(norm, rows, time.perf_counter())
+        rid = (next(self._req_seq) if request_id is None
+               else request_id)
+        req = _Request(norm, rows, time.perf_counter(), rid)
         with self._cv:
             self._check_accepting()
             while (len(self._pending) >= self.max_queue
@@ -533,6 +547,59 @@ class BatchingInferenceServer(object):
             'compute_p99_ms': comp.quantile(0.99) * 1e3,
             'per_bucket': per_bucket,
             'buckets': list(self._buckets),
+        }
+
+    def resident_bytes(self):
+        """Modeled HBM residency of this servable: what serving this
+        bucket ladder keeps resident on the device.  Per bucket, the
+        artifact's serialized size (StableHLO module + the params baked
+        into it as constants — each bucket bakes its OWN copy) plus the
+        compiled executable's XLA ``memory_analysis()`` components
+        (argument/output/temp buffers, generated code) when the bucket
+        has compiled.  The sum over the ladder is the per-servable
+        estimate the fleet's ``paddle_tpu_serving_resident_bytes``
+        gauges and the deploy() HBM-budget precheck read.
+
+        ``servable_key`` identifies the SHARED compiled servable:
+        in-process replicas built with ``share_artifacts_with=`` report
+        the same key, so a fleet aggregate can count the one servable
+        once instead of once per dispatch lane."""
+        per_bucket = {}
+        total = 0
+        for b in self._buckets:
+            e = {'compiled': b in self._compiled}
+            p = self._bucket_paths.get(b)
+            if p:
+                try:
+                    e['artifact_bytes'] = os.path.getsize(p)
+                except OSError:
+                    pass
+            fn = self._compiled.get(b)
+            if fn is not None:
+                try:
+                    ma = fn.memory_analysis()
+                except Exception:
+                    ma = None
+                if ma is not None:
+                    e['argument_bytes'] = int(ma.argument_size_in_bytes)
+                    e['output_bytes'] = int(ma.output_size_in_bytes)
+                    e['temp_bytes'] = int(ma.temp_size_in_bytes)
+                    e['code_bytes'] = int(
+                        ma.generated_code_size_in_bytes)
+            e['estimate_bytes'] = (
+                e.get('artifact_bytes', 0) + e.get('argument_bytes', 0)
+                + e.get('output_bytes', 0) + e.get('temp_bytes', 0)
+                + e.get('code_bytes', 0))
+            total += e['estimate_bytes']
+            per_bucket[b] = e
+        return {
+            'total_bytes': int(total),
+            'per_bucket': per_bucket,
+            'servable_key': id(self._compiled),
+            'basis': 'per-bucket artifact size (serialized module + '
+                     'baked params) + compiled argument/output/temp/'
+                     'code bytes from XLA memory_analysis, summed '
+                     'over the ladder',
         }
 
     def close(self, timeout=10.0):
@@ -734,6 +801,12 @@ class BatchingInferenceServer(object):
                 stacked = jax.device_put(stacked)
             outs = list(fn(stacked, srv._key))
         except Exception as e:
+            # crash forensics for the dispatch thread (the executor
+            # path's PADDLE_TPU_TRACE_DUMP_ON_ERROR contract extended
+            # to serving): dump the ring tagged with this server's id.
+            # maybe_dump_on_error never raises — the clients' futures
+            # carry the ORIGINAL error either way
+            _tlm.maybe_dump_on_error(tag=self._m._sid)
             for r in reqs:
                 r.future.set_exception(e)
             with self._cv:
@@ -743,6 +816,16 @@ class BatchingInferenceServer(object):
             return
         rows = offsets[-1][1]
         t_launch = time.perf_counter()
+        tl = _tlm.ring_if_armed()
+        if tl is not None:
+            # per-request queue-wait regions: submit -> dispatch,
+            # tagged with the threaded request id and the bucket the
+            # request rode out in (Perfetto shows wait vs compute)
+            for r in reqs:
+                tl.record('serving.queue_wait', 'span',
+                          t0=r.t_submit, dur=t_launch - r.t_submit,
+                          args={'request_id': r.rid, 'bucket': bucket,
+                                'server': self._m._sid})
         self._m.batches.inc()
         self._m.batch_rows.inc(rows)
         self._m.batch_capacity.inc(bucket)
@@ -766,6 +849,7 @@ class BatchingInferenceServer(object):
             try:
                 host = [np.asarray(o) for o in outs]
             except Exception as e:  # pragma: no cover - defensive
+                _tlm.maybe_dump_on_error(tag=self._m._sid)
                 for r in reqs:
                     r.future.set_exception(e)
                 with self._cv:
@@ -783,6 +867,14 @@ class BatchingInferenceServer(object):
             # compute span = dispatch to host sync, one sample per batch
             self._m.compute(bucket).observe(now - t_launch)
             self._m.compute('all').observe(now - t_launch)
+            tl = _tlm.ring_if_armed()
+            if tl is not None:
+                tl.record('serving.compute', 'compute', t0=t_launch,
+                          dur=now - t_launch,
+                          args={'bucket': bucket,
+                                'rows': offsets[-1][1],
+                                'server': self._m._sid,
+                                'request_ids': [r.rid for r in reqs]})
             self._m.completed.inc(len(reqs))
             for r in reqs:
                 self._m.latency.observe(now - r.t_submit)

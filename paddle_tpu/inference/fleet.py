@@ -56,11 +56,24 @@ their version label across hot-swaps, so a rollout is visible in
 replica-state counts — read live at scrape time instead of
 push-updated on every transition.
 
+- **Resident-bytes accounting**: each replica snapshots its servable's
+  ``BatchingInferenceServer.resident_bytes()`` estimate post-warmup,
+  exported as ``paddle_tpu_serving_resident_bytes`` gauges
+  (fleet/replica/version); the fleet aggregate counts a shared
+  compiled servable ONCE, and a lifetime watermark records the
+  deploy-overlap peak (old + incoming version both resident).
+  :meth:`deploy` prechecks the projected overlap residency against
+  ``hbm_budget_bytes`` (default ``PADDLE_TPU_PEAK_HBM_BYTES``) —
+  **warn-only**: over-budget deploys are logged and counted
+  (``paddle_tpu_fleet_hbm_budget_precheck_failures_total``), never
+  blocked; the enforcing admission control is ROADMAP item 5.
+
 The fleet is opt-in and additive: nothing here is imported on the
 single-replica path, and a bare ``BatchingInferenceServer`` behaves
 byte-for-byte as before when no fleet is constructed.
 """
 import itertools
+import logging
 import os
 import tempfile
 import threading
@@ -72,7 +85,10 @@ import numpy as np
 from .. import io as _io
 from .. import observability as _obs
 from ..flags import FLAGS
+from ..observability import timeline as _tlm
 from .batching import BatchingInferenceServer
+
+_log = logging.getLogger(__name__)
 
 __all__ = ['ServingFleet']
 
@@ -121,8 +137,8 @@ def _run_backgrounded(fn):
 class _Replica(object):
     """One BatchingInferenceServer plus its fleet-side lifecycle."""
     __slots__ = ('rid', 'version', 'version_dir', 'server', 'state',
-                 'failures', 'probe_feed', 'warmup_s', 'm_dispatch',
-                 'm_dispatch_failures')
+                 'failures', 'probe_feed', 'warmup_s', 'resident',
+                 'm_dispatch', 'm_dispatch_failures', 'm_resident')
 
     def __init__(self, rid, version, version_dir, server, probe_feed,
                  warmup_s):
@@ -134,8 +150,12 @@ class _Replica(object):
         self.failures = 0
         self.probe_feed = probe_feed
         self.warmup_s = warmup_s
+        # the server's resident_bytes() snapshot, taken post-warmup
+        # (static from then on: the ladder is fully AOT-compiled)
+        self.resident = server.resident_bytes()
         self.m_dispatch = None           # set by _FleetMetrics.bind
         self.m_dispatch_failures = None
+        self.m_resident = None
 
 
 class _FleetMetrics(object):
@@ -191,6 +211,13 @@ class _FleetMetrics(object):
             'health-check probes that failed (replica stays '
             'unroutable)', L))
 
+        self.budget_precheck_failures = child(reg.counter(
+            'paddle_tpu_fleet_hbm_budget_precheck_failures_total',
+            'deploys whose projected resident bytes (live servables + '
+            'incoming version, deploy-overlap moment) exceeded the '
+            'HBM budget — warn-only today, the admission-control '
+            'input of ROADMAP item 5', L))
+
         self._dispatches = reg.counter(
             'paddle_tpu_fleet_dispatches_total',
             'requests dispatched per replica (version-labeled, so a '
@@ -198,6 +225,11 @@ class _FleetMetrics(object):
         self._dispatch_failures = reg.counter(
             'paddle_tpu_fleet_dispatch_failures_total',
             'dispatch failures per replica', LR)
+        self._resident = reg.gauge(
+            'paddle_tpu_serving_resident_bytes',
+            'modeled resident bytes of each replica servable '
+            '(artifact + compiled-executable estimates; replicas '
+            'sharing one compiled servable report the same value)', LR)
 
         # pull-style aggregates: live fleet state read at scrape time
         self._g_queue = reg.gauge(
@@ -221,19 +253,35 @@ class _FleetMetrics(object):
             self._g_replicas.labels(fleet=fid, state=st).set_function(
                 fns['state_count'](st))
             self._replica_state_labels.append(st)
+        self._g_resident = reg.gauge(
+            'paddle_tpu_fleet_resident_bytes',
+            'modeled resident bytes across live servables, shared '
+            'compiled servables counted once (callback gauge, read '
+            'live)', L)
+        self._families.append(self._g_resident)
+        self._g_resident.labels(fleet=fid).set_function(fns['resident'])
+        self.resident_watermark = child(reg.gauge(
+            'paddle_tpu_fleet_resident_bytes_watermark',
+            'highest fleet resident-bytes estimate observed, '
+            'deploy-overlap moments (old + incoming version both '
+            'live) included', L))
 
     def bind(self, rep):
         """Create (and attach) the per-replica counter children."""
         kv = dict(fleet=self._fid, replica=rep.rid, version=rep.version)
         rep.m_dispatch = self._dispatches.labels(**kv)
         rep.m_dispatch_failures = self._dispatch_failures.labels(**kv)
+        rep.m_resident = self._resident.labels(**kv)
+        rep.m_resident.set(rep.resident['total_bytes'])
         self._replica_families.append((self._dispatches, kv))
         self._replica_families.append((self._dispatch_failures, kv))
+        self._replica_families.append((self._resident, kv))
 
     def unbind(self, rep):
         """Retire a replica's label series (handles stay readable)."""
         kv = dict(fleet=self._fid, replica=rep.rid, version=rep.version)
-        for fam in (self._dispatches, self._dispatch_failures):
+        for fam in (self._dispatches, self._dispatch_failures,
+                    self._resident):
             fam.remove(**kv)
             try:
                 self._replica_families.remove((fam, kv))
@@ -279,11 +327,19 @@ class ServingFleet(object):
     def __init__(self, version_dir, replicas=None, version=None,
                  state_dir=None, unroutable_after=None, retry_limit=None,
                  health_interval_ms=None, drain_timeout_s=None,
-                 **server_kwargs):
+                 hbm_budget_bytes=None, **server_kwargs):
         self._fid = 'f%d' % next(_fleet_seq)
         self._lock = threading.Lock()
         self._deploy_lock = threading.Lock()
         self._rr = itertools.count()
+        self._req_seq = itertools.count()  # fleet-level request ids
+        # warn-only HBM budget for the deploy() resident-bytes
+        # precheck; 0 = off.  Defaults to PADDLE_TPU_PEAK_HBM_BYTES so
+        # a box-wide budget applies without per-fleet wiring
+        self._hbm_budget = int(
+            hbm_budget_bytes if hbm_budget_bytes is not None
+            else (FLAGS.peak_hbm_bytes or 0))
+        self._resident_watermark = 0
         self._server_kwargs = dict(server_kwargs)
         self._default_replicas = int(
             replicas if replicas is not None else FLAGS.fleet_replicas)
@@ -323,6 +379,7 @@ class ServingFleet(object):
             'queued_rows': lambda: self._aggregate('queued_rows'),
             'in_flight': lambda: self._aggregate('in_flight_batches'),
             'state_count': lambda st: (lambda: self._state_count(st)),
+            'resident': lambda: self._resident_total(),
         })
         if _obs.enabled():
             _obs.maybe_serve_from_env()
@@ -348,13 +405,19 @@ class ServingFleet(object):
         """Route one request onto the least-loaded replica; returns a
         Future of [output arrays].  The Future only carries an
         exception after the fleet ran out of retry budget AND distinct
-        replicas — a single replica failure is invisible to clients."""
+        replicas — a single replica failure is invisible to clients.
+
+        Each request gets a monotonic fleet-level ``request_id``,
+        threaded through the replica's dispatch spans so an armed
+        flight-recorder trace shows one request's routing, queue-wait,
+        and compute regions under one id."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("ServingFleet is closed")
         fut = Future()
         self._m.requests.inc()
-        self._dispatch(feed, fut, frozenset(), 0, None)
+        self._dispatch(feed, fut, frozenset(), 0, None,
+                       next(self._req_seq))
         return fut
 
     def predict(self, feed, timeout=None):
@@ -385,20 +448,22 @@ class ServingFleet(object):
                     best, best_key = r, key
             return best
 
-    def _dispatch(self, feed, fut, tried, attempts, last_exc):
+    def _dispatch(self, feed, fut, tried, attempts, last_exc, rid):
         """Try replicas until one accepts the request (its Future then
         drives completion via _on_done) or the retry budget is spent."""
         while True:
+            t_pick = time.perf_counter()
             rep = self._pick(tried)
             if rep is None:
                 self._m.failed.inc()
+                _tlm.maybe_dump_on_error(tag=self._fid)
                 fut.set_exception(last_exc or RuntimeError(
                     "ServingFleet %s has no routable replica (all "
                     "unroutable/draining or already tried for this "
                     "request)" % self._fid))
                 return
             try:
-                inner = rep.server.submit(feed)
+                inner = rep.server.submit(feed, request_id=rid)
             except Exception as e:
                 # submit itself failed (replica raced into drain/close,
                 # or rejected the request shape).  Validation errors are
@@ -412,18 +477,30 @@ class ServingFleet(object):
                 last_exc = e
                 if attempts >= self._retry_limit:
                     self._m.failed.inc()
+                    _tlm.maybe_dump_on_error(
+                        tag='%s_%s' % (self._fid, rep.version))
                     fut.set_exception(e)
                     return
                 attempts += 1
                 self._m.retries.inc()
                 continue
             rep.m_dispatch.inc()
+            tl = _tlm.ring_if_armed()
+            if tl is not None:
+                # the routing decision, under the same request_id the
+                # replica's queue-wait/compute spans carry
+                tl.record('fleet.dispatch', 'span', t0=t_pick,
+                          dur=time.perf_counter() - t_pick,
+                          args={'request_id': rid,
+                                'replica': rep.rid,
+                                'version': rep.version,
+                                'attempt': attempts})
             inner.add_done_callback(
                 lambda f, rep=rep, tried=tried, attempts=attempts:
-                self._on_done(rep, feed, fut, tried, attempts, f))
+                self._on_done(rep, feed, fut, tried, attempts, f, rid))
             return
 
-    def _on_done(self, rep, feed, fut, tried, attempts, inner):
+    def _on_done(self, rep, feed, fut, tried, attempts, inner, rid):
         """Runs in the replica's collector thread when its Future
         resolves: deliver, or strike the replica and re-dispatch."""
         exc = inner.exception()
@@ -436,10 +513,16 @@ class ServingFleet(object):
         self._note_failure(rep)
         if attempts >= self._retry_limit:
             self._m.failed.inc()
+            # dispatch-thread crash forensics, tagged with the fleet +
+            # the version whose replica finally failed; never masks
+            # the original error (the Future carries `exc` either way)
+            _tlm.maybe_dump_on_error(
+                tag='%s_%s' % (self._fid, rep.version))
             fut.set_exception(exc)
             return
         self._m.retries.inc()
-        self._dispatch(feed, fut, tried | {rep.rid}, attempts + 1, exc)
+        self._dispatch(feed, fut, tried | {rep.rid}, attempts + 1, exc,
+                       rid)
 
     def _note_failure(self, rep):
         with self._lock:
@@ -535,6 +618,7 @@ class ServingFleet(object):
                 # close() raced the build: don't leak the replica
                 self._retire([rep])
                 raise RuntimeError("ServingFleet is closed")
+            self._note_resident_watermark()
             return rep.rid
 
     def remove_replica(self, rid=None):
@@ -578,16 +662,23 @@ class ServingFleet(object):
             self._m.unbind(rep)
 
     # -- versioned deployment ------------------------------------------
-    def deploy(self, version_dir, replicas=None, version=None):
+    def deploy(self, version_dir, replicas=None, version=None,
+               hbm_budget_bytes=None):
         """Hot-swap the model version with zero dropped requests:
 
         1. resolve ``version_dir`` (``io.resolve_version_dir``);
-        2. build + AOT-warm a full replica set for it — the serving
+        2. HBM-budget precheck (warn-only): project the overlap-moment
+           residency — live servables + the incoming version — against
+           ``hbm_budget_bytes`` (default: the fleet's budget /
+           ``PADDLE_TPU_PEAK_HBM_BYTES``); over budget logs and counts
+           ``paddle_tpu_fleet_hbm_budget_precheck_failures_total`` but
+           never blocks (the enforcing flip is ROADMAP item 5);
+        3. build + AOT-warm a full replica set for it — the serving
            set is untouched, traffic keeps flowing;
-        3. atomically flip routing to the new set;
-        4. record the deployment (``io.write_rollback_json`` archives
+        4. atomically flip routing to the new set;
+        5. record the deployment (``io.write_rollback_json`` archives
            the superseded record as ``.prev`` — rollback() reads it);
-        5. drain + close the old set (their queued work completes).
+        6. drain + close the old set (their queued work completes).
 
         Returns the deployed version name.  Serialized against
         concurrent deploy/add/remove; client submits never block on
@@ -601,6 +692,10 @@ class ServingFleet(object):
                 n = (int(replicas) if replicas is not None
                      else (len(self._replicas)
                            or self._default_replicas))
+            self._precheck_hbm_budget(
+                vname, paths,
+                self._hbm_budget if hbm_budget_bytes is None
+                else int(hbm_budget_bytes))
             new = []
             try:
                 for _ in range(n):
@@ -615,6 +710,9 @@ class ServingFleet(object):
             except Exception:
                 self._retire(new)
                 raise
+            # the rollout overlap moment: the incoming set is built
+            # and the outgoing set still serves — residency peaks HERE
+            self._note_resident_watermark(extra=new)
             with self._lock:
                 # re-check under the lock: close() may have raced the
                 # (long) build — it retired the old set already, so
@@ -653,6 +751,71 @@ class ServingFleet(object):
         self._m.rollbacks.inc()
         return self.deploy(rec['dir'],
                            replicas=rec.get('replicas'))
+
+    # -- resident-bytes accounting -------------------------------------
+    def _resident_total(self, extra=()):
+        """Modeled resident bytes across live replicas (READY /
+        UNROUTABLE / DRAINING — a draining replica's servable is still
+        on the device) plus ``extra`` (a freshly built set mid-deploy).
+        Replicas sharing one compiled servable
+        (``share_artifacts_with``) are counted ONCE, keyed by the
+        shared servable identity."""
+        with self._lock:
+            reps = [r for r in self._replicas if r.state in _STATES]
+        seen = set()
+        total = 0
+        for r in list(reps) + list(extra):
+            key = r.resident.get('servable_key')
+            if key in seen:
+                continue
+            seen.add(key)
+            total += r.resident.get('total_bytes', 0)
+        return total
+
+    def _note_resident_watermark(self, extra=()):
+        """Advance the fleet resident-bytes watermark.  Called at the
+        points residency can peak: after the initial build, after
+        add_replica, and at a deploy's overlap moment — the incoming
+        set is built and the outgoing set still serves."""
+        v = self._resident_total(extra=extra)
+        if v > self._resident_watermark:
+            self._resident_watermark = v
+            self._m.resident_watermark.set(v)
+        return v
+
+    def _precheck_hbm_budget(self, vname, paths, budget):
+        """Warn-only deploy admission precheck: BEFORE paying the
+        replica build, project the overlap-moment residency (live
+        servables + the incoming version's artifacts, estimated from
+        their serialized sizes — the baked-params proxy available
+        pre-compile) against the budget.  Over budget logs + counts;
+        the deploy proceeds — this is the observability groundwork
+        ROADMAP item 5's enforcing admission control will flip."""
+        if not budget or budget <= 0:
+            return None
+        incoming = 0
+        for p in paths.values():
+            try:
+                incoming += os.path.getsize(p)
+            except OSError:
+                pass
+        live = self._resident_total()
+        projected = live + incoming
+        verdict = {'budget_bytes': int(budget),
+                   'live_bytes': int(live),
+                   'incoming_bytes': int(incoming),
+                   'projected_bytes': int(projected),
+                   'over_budget': projected > budget}
+        if verdict['over_budget']:
+            self._m.budget_precheck_failures.inc()
+            _log.warning(
+                "fleet %s deploy of version %r would exceed the HBM "
+                "budget at the rollout overlap: live %d B + incoming "
+                "~%d B = %d B > budget %d B.  Proceeding anyway "
+                "(warn-only precheck; admission control is ROADMAP "
+                "item 5)", self._fid, vname, live, incoming, projected,
+                budget)
+        return verdict
 
     # -- introspection -------------------------------------------------
     def _aggregate(self, field):
@@ -693,6 +856,7 @@ class ServingFleet(object):
                 'warmup_s': round(r.warmup_s, 3),
                 'compiles': s['compiles'],
                 'compiles_after_warmup': s['compiles_after_warmup'],
+                'resident_bytes': r.resident.get('total_bytes', 0),
                 'queue': r.server.queue_state(),
                 'server': s,
             })
@@ -715,6 +879,11 @@ class ServingFleet(object):
             'rollbacks': int(m.rollbacks.value),
             'unroutable_marks': int(m.unroutable_marks.value),
             'health_probes': int(m.probes.value),
+            'resident_bytes': self._resident_total(),
+            'resident_bytes_watermark': self._resident_watermark,
+            'hbm_budget_bytes': self._hbm_budget,
+            'hbm_budget_precheck_failures':
+                int(m.budget_precheck_failures.value),
         }
 
     # -- shutdown ------------------------------------------------------

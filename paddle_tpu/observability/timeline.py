@@ -21,7 +21,19 @@ This module is the one buffer those events land in:
 - **Crash forensics** — ``PADDLE_TPU_TRACE_DUMP_ON_ERROR=1`` makes the
   executor dump the last ``PADDLE_TPU_TRACE_STEPS`` steps of the ring to
   ``trace_<pid>_error.json`` on any executor exception, so a long run
-  that dies at step 40k leaves its final timeline behind.
+  that dies at step 40k leaves its final timeline behind.  The serving
+  dispatch threads (batching server, fleet) dump too, tagged with their
+  server id / fleet+version (``trace_<pid>_error_<tag>.json``).
+- **Counter tracks** — :meth:`Timeline.counter_sample` samples render as
+  Chrome ``ph:"C"`` counter events: the executor exports the memory
+  model's live-bytes sawtooth (``paddle_tpu.modeled_live_bytes``,
+  stepping along op_seq across the compute window) next to measured
+  ``paddle_tpu.device_bytes_in_use`` samples when the backend reports
+  ``memory_stats()``.
+- **Summary CLI** — ``python -m paddle_tpu.observability.timeline
+  <trace.json>`` prints top-N phases by total wall, a per-step phase
+  table, and each memory counter track's min/max — traces triage from
+  a terminal without loading Perfetto.
 
 Zero-cost when disabled: instrument sites guard on :func:`armed` /
 :func:`ring_if_armed` — one cached-bool check, no ring allocation, no
@@ -37,7 +49,7 @@ import time
 
 __all__ = ['ring', 'ring_if_armed', 'armed', 'reload_armed', 'reset',
            'record', 'set_step', 'export_chrome_trace', 'maybe_flush',
-           'maybe_dump_on_error', 'Timeline']
+           'maybe_dump_on_error', 'device_memory_stats', 'Timeline']
 
 # process clock origin: every event's ts is perf_counter-relative to
 # this, so exported traces start near t=0 instead of an opaque epoch
@@ -45,7 +57,7 @@ _PC0 = time.perf_counter()
 
 # event categories (the `cat` field; Perfetto colors/filters by it)
 CATEGORIES = ('feed', 'compute', 'compile', 'update', 'collective',
-              'donation', 'span', 'user')
+              'donation', 'span', 'user', 'memory')
 
 
 def _event_cap():
@@ -88,6 +100,21 @@ class Timeline(object):
         with self._lock:
             self._dq.append(e)
 
+    def counter_sample(self, name, value, cat='memory', t0=None,
+                       step=None):
+        """Append one counter sample (Chrome ``ph:"C"`` on export): a
+        stepped series — live bytes along op_seq, measured device
+        bytes-in-use — rendered as its own counter track in Perfetto.
+        ``value`` lands in ``args['bytes']``."""
+        if t0 is None:
+            t0 = time.perf_counter()
+        e = {'name': name, 'cat': cat, 'ts': t0 - _PC0, 'dur': 0.0,
+             'step': self._step if step is None else int(step),
+             'tid': threading.get_ident(), 'ph': 'C',
+             'args': {'bytes': int(value)}}
+        with self._lock:
+            self._dq.append(e)
+
     def events(self, cat=None, last_steps=0):
         """Snapshot of the ring, optionally filtered to one category
         and/or to events of the trailing ``last_steps`` steps."""
@@ -117,11 +144,18 @@ class Timeline(object):
             {'name': 'process_name', 'ph': 'M', 'pid': pid, 'tid': 0,
              'args': {'name': 'paddle_tpu executor (pid %d)' % pid}}]
         for e in evs:
-            te = {'name': e['name'], 'cat': e['cat'], 'ph': 'X',
-                  'ts': round(e['ts'] * 1e6, 3),
-                  'dur': round(e['dur'] * 1e6, 3),
-                  'pid': pid, 'tid': e['tid'],
-                  'args': dict(e['args'] or {}, step=e['step'])}
+            if e.get('ph') == 'C':
+                # counter sample: args hold exactly the series values
+                # (adding `step` here would graph as a second series)
+                te = {'name': e['name'], 'cat': e['cat'], 'ph': 'C',
+                      'ts': round(e['ts'] * 1e6, 3), 'pid': pid,
+                      'tid': 0, 'args': dict(e['args'] or {})}
+            else:
+                te = {'name': e['name'], 'cat': e['cat'], 'ph': 'X',
+                      'ts': round(e['ts'] * 1e6, 3),
+                      'dur': round(e['dur'] * 1e6, 3),
+                      'pid': pid, 'tid': e['tid'],
+                      'args': dict(e['args'] or {}, step=e['step'])}
             trace_events.append(te)
         doc = {'traceEvents': trace_events, 'displayTimeUnit': 'ms'}
         d = os.path.dirname(path)
@@ -222,15 +256,138 @@ def maybe_flush():
         return None  # an unwritable trace dir must not fail the step
 
 
-def maybe_dump_on_error():
-    """Flush the last-N-steps ring on an executor exception when
-    PADDLE_TPU_TRACE_DUMP_ON_ERROR is armed (crash forensics).  Never
-    raises — the original exception must surface, not a dump failure."""
+def maybe_dump_on_error(tag=None):
+    """Flush the last-N-steps ring on an executor/dispatch exception
+    when PADDLE_TPU_TRACE_DUMP_ON_ERROR is armed (crash forensics).
+    ``tag`` distinguishes non-executor dump sites — the serving
+    dispatch threads pass their server id / fleet+version so a
+    mid-rollout crash says WHOSE timeline this is
+    (``trace_<pid>_error_<tag>.json``).  Never raises — the original
+    exception must surface, not a dump failure."""
     if not _armed_tuple()[2]:
         return None
     try:
         from ..flags import FLAGS
+        suffix = '_error'
+        if tag:
+            import re
+            suffix += '_' + re.sub(r'[^A-Za-z0-9_.-]', '_', str(tag))
         return ring().export_chrome_trace(
-            _trace_path('_error'), last_steps=int(FLAGS.trace_steps))
+            _trace_path(suffix), last_steps=int(FLAGS.trace_steps))
     except Exception:
         return None
+
+
+def device_memory_stats(device=None):
+    """Measured device memory via ``device.memory_stats()`` (int fields
+    only, e.g. ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``
+    on TPU).  Returns None when the backend provides nothing — CPU
+    backends do not — so report consumers can say ``measured: None``
+    honestly instead of printing a made-up zero."""
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        ms = d.memory_stats()
+    except Exception:
+        return None
+    if not ms:
+        return None
+    out = {}
+    for k, v in ms.items():
+        try:
+            out[k] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# summary CLI: triage an exported trace without loading Perfetto
+# ---------------------------------------------------------------------------
+
+def summarize_trace(doc, top=10, step_rows=16):
+    """Summarize a Chrome trace_event document (the dict form of an
+    exported ``trace_<pid>.json``) into printable lines: top-N phases
+    by total wall, a per-step phase-wall table, and min/max per memory
+    counter track.  Pure — the CLI prints its return value, tests
+    assert on it."""
+    evs = doc.get('traceEvents', [])
+    spans = [e for e in evs if e.get('ph') == 'X']
+    counters = [e for e in evs if e.get('ph') == 'C']
+
+    lines = []
+    by_name = {}
+    for e in spans:
+        agg = by_name.setdefault(e['name'], [0, 0.0])
+        agg[0] += 1
+        agg[1] += float(e.get('dur', 0.0))
+    lines.append('top phases by total wall (%d span events):'
+                 % len(spans))
+    ranked = sorted(by_name.items(), key=lambda kv: -kv[1][1])[:top]
+    for name, (count, total_us) in ranked:
+        lines.append('  %-34s %8.3f ms  x%d' % (name, total_us / 1e3,
+                                                count))
+
+    by_step = {}
+    for e in spans:
+        step = (e.get('args') or {}).get('step')
+        if step is None:
+            continue
+        row = by_step.setdefault(int(step), {})
+        cat = e.get('cat', 'user')
+        row[cat] = row.get(cat, 0.0) + float(e.get('dur', 0.0))
+    if by_step:
+        cats = sorted({c for row in by_step.values() for c in row})
+        lines.append('')
+        lines.append('per-step phase walls (ms), last %d steps:'
+                     % step_rows)
+        lines.append('  %-8s' % 'step'
+                     + ''.join('%12s' % c for c in cats))
+        for step in sorted(by_step)[-step_rows:]:
+            row = by_step[step]
+            lines.append('  %-8d' % step + ''.join(
+                '%12.3f' % (row.get(c, 0.0) / 1e3) for c in cats))
+
+    if counters:
+        series = {}
+        for e in counters:
+            for k, v in (e.get('args') or {}).items():
+                s = series.setdefault('%s.%s' % (e['name'], k), [])
+                s.append(float(v))
+        lines.append('')
+        lines.append('counter tracks (min / max / last):')
+        for name in sorted(series):
+            vals = series[name]
+            lines.append('  %-44s %14.0f %14.0f %14.0f'
+                         % (name, min(vals), max(vals), vals[-1]))
+    if not spans and not counters:
+        lines.append('(trace carries no span or counter events)')
+    return lines
+
+
+def _cli(argv):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog='python -m paddle_tpu.observability.timeline',
+        description='Summarize an exported Chrome trace '
+                    '(PADDLE_TPU_TRACE_DIR flight-recorder output): '
+                    'top phases by wall, per-step phase table, memory '
+                    'counter min/max.')
+    ap.add_argument('trace', help='path to a trace_<pid>.json export')
+    ap.add_argument('--top', type=int, default=10,
+                    help='how many phases to rank (default 10)')
+    ap.add_argument('--steps', type=int, default=16,
+                    help='trailing steps in the per-step table '
+                         '(default 16)')
+    args = ap.parse_args(argv)
+    with open(args.trace) as f:
+        doc = json.load(f)
+    for line in summarize_trace(doc, top=args.top,
+                                step_rows=args.steps):
+        print(line)
+    return 0
+
+
+if __name__ == '__main__':  # pragma: no cover - exercised via tests
+    import sys
+    sys.exit(_cli(sys.argv[1:]))

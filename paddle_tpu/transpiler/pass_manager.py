@@ -12,9 +12,10 @@ into an explicit pipeline:
   ``order``, a ``report_key``, and a kind (``rewrite`` | ``analysis``);
   tools/check_pass_registry.py statically audits the registry and
   cross-checks it against the verifier mutation-test matrix.  The
-  analysis tail is donation (order 90) then the static cost model
+  analysis tail is donation (order 90), the static cost model
   (order 95, transpiler/cost_model.py — after AMP so low-precision
-  bytes count).
+  bytes count), then the liveness-based peak-memory model (order 96,
+  transpiler/memory_model.py, nested under the cost report).
 - ``run_pipeline`` builds the plan for the current configuration
   (graph-opt level, AMP mode), runs each pass on an isolated copy —a
   crashing pass is skipped with a per-pass report entry, it can no
@@ -186,6 +187,19 @@ def _cost_model(program, ctx):
         feed_specs=ctx.feed_specs)}
 
 
+@register_pass('memory_model', 96, 'memory', kind='analysis',
+               enabled=lambda cfg: cfg.level >= 1)
+def _memory_model(program, ctx):
+    # right after the cost model, same post-rewrite program and
+    # feed-spec-seeded shapes (the memoized infer cache is warm from
+    # the cost walk): modeled peak resident bytes + per-op live-bytes
+    # timeline, reported under last_graph_opt_report['cost']['memory']
+    from . import memory_model
+    return {'memory': memory_model.analyze_memory(
+        program, fetch_names=ctx.fetch_names,
+        feed_specs=ctx.feed_specs)}
+
+
 # ---------------------------------------------------------------------------
 # plan building + the composite cache key
 # ---------------------------------------------------------------------------
@@ -353,6 +367,10 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
             report['amp'] = frag['amp']
         if frag.get('cost') is not None:
             report['cost'] = frag['cost']
+        if frag.get('memory') is not None:
+            # the memory model nests under the cost report — ONE
+            # 'cost' entry carries the whole static-analysis story
+            report.setdefault('cost', {})['memory'] = frag['memory']
 
     if graph_opt_ran:
         report['ops_after'] = len(p.global_block().ops)

@@ -77,9 +77,9 @@ def test_bucket_selection(server):
 def test_assemble_offsets_and_padding(server):
     rng = np.random.RandomState(0)
     reqs = []
-    for rows in (1, 2, 1):
+    for i, rows in enumerate((1, 2, 1)):
         norm, k = server._normalize(_feed(rng, rows))
-        reqs.append(_Request(norm, k, 0.0))
+        reqs.append(_Request(norm, k, 0.0, i))
     bucket, stacked, offsets = server._assemble(reqs)
     assert bucket == 4
     assert offsets == [(0, 1), (1, 3), (3, 4)]
@@ -319,3 +319,124 @@ def test_throughput_acceptance_ctr_style():
     assert st['compiles_after_warmup'] == 0
     assert st['mean_batch_occupancy'] > 2
     assert float(np.median(ratios)) >= 1.5, ratios
+
+
+# -- HBM observability PR: resident bytes, request ids, dispatch dumps ----
+
+def test_resident_bytes_accounting(server):
+    rb = server.resident_bytes()
+    assert rb['total_bytes'] > 0
+    assert sorted(rb['per_bucket']) == bucket_sizes(MAX_BATCH)
+    for b, e in rb['per_bucket'].items():
+        assert e['compiled'] is True  # warmup compiled the ladder
+        assert e['artifact_bytes'] > 0
+        assert e['estimate_bytes'] >= e['artifact_bytes']
+    assert rb['total_bytes'] == sum(
+        e['estimate_bytes'] for e in rb['per_bucket'].values())
+    # shared-servable identity is stable for the fleet's dedupe
+    assert rb['servable_key'] == server.resident_bytes()['servable_key']
+
+
+def test_shared_servable_reports_same_key(bucket_paths):
+    a = BatchingInferenceServer(bucket_paths, warmup=False)
+    b = BatchingInferenceServer(bucket_paths, warmup=False,
+                                share_artifacts_with=a)
+    c = BatchingInferenceServer(bucket_paths, warmup=False)
+    try:
+        assert a.resident_bytes()['servable_key'] == \
+            b.resident_bytes()['servable_key']
+        assert a.resident_bytes()['servable_key'] != \
+            c.resident_bytes()['servable_key']
+    finally:
+        for s in (a, b, c):
+            s.close()
+
+
+def test_request_ids_are_monotonic_and_threadable(server,
+                                                   monkeypatch):
+    """The ids submit() actually ATTACHES to requests advance
+    monotonically, and an explicit upstream id passes through
+    untouched — asserted on the _Request objects themselves (spying
+    the class), not on the counter, which would advance regardless."""
+    from paddle_tpu.inference import batching as batching_mod
+    seen = []
+    real = batching_mod._Request
+
+    class Spy(real):
+        def __init__(self, feed, rows, t_submit, rid):
+            seen.append(rid)
+            real.__init__(self, feed, rows, t_submit, rid)
+
+    monkeypatch.setattr(batching_mod, '_Request', Spy)
+    rng = np.random.RandomState(3)
+    server.submit(_feed(rng)).result(timeout=30.0)
+    server.submit(_feed(rng)).result(timeout=30.0)
+    # an upstream (fleet) id threads through untouched
+    server.submit(_feed(rng),
+                  request_id='fleet-77').result(timeout=30.0)
+    server.submit(_feed(rng)).result(timeout=30.0)
+    assert seen[2] == 'fleet-77'
+    auto = [r for r in seen if r != 'fleet-77']
+    assert len(auto) == 3
+    assert auto == sorted(auto) and len(set(auto)) == 3
+
+
+def test_dispatch_spans_carry_request_id(bucket_paths, monkeypatch,
+                                         tmp_path):
+    from paddle_tpu.observability import timeline
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    timeline.reset()
+    srv = BatchingInferenceServer(bucket_paths, max_wait_ms=20.0)
+    try:
+        rng = np.random.RandomState(5)
+        srv.submit(_feed(rng), request_id=4242).result(timeout=30.0)
+        deadline = time.time() + 10.0
+        comp = qw = None
+        while time.time() < deadline and not (comp and qw):
+            evs = timeline.ring().events()
+            qw = [e for e in evs if e['name'] == 'serving.queue_wait'
+                  and e['args'].get('request_id') == 4242] or None
+            comp = [e for e in evs if e['name'] == 'serving.compute'
+                    and 4242 in e['args'].get('request_ids', ())] \
+                or None
+            time.sleep(0.01)
+        assert qw, 'queue-wait span with the threaded id missing'
+        assert comp, 'compute span with the threaded id missing'
+        assert qw[0]['args']['bucket'] == 1
+        assert 'server' in qw[0]['args']
+    finally:
+        srv.close()
+        timeline.reset()
+
+
+def test_dispatch_thread_error_dumps_tagged_trace(bucket_paths,
+                                                  monkeypatch,
+                                                  tmp_path):
+    """A dispatch-thread exception under PADDLE_TPU_TRACE_DUMP_ON_ERROR
+    leaves a ring dump tagged with the server id — and the client still
+    sees the ORIGINAL error."""
+    import os
+    from paddle_tpu.observability import timeline
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DUMP_ON_ERROR', '1')
+    timeline.reset()
+    srv = BatchingInferenceServer(bucket_paths, max_wait_ms=10.0)
+    try:
+        def boom(bucket):
+            raise RuntimeError('injected bucket failure')
+        srv._ensure_compiled = boom
+        rng = np.random.RandomState(9)
+        fut = srv.submit(_feed(rng))
+        with pytest.raises(RuntimeError, match='injected bucket'):
+            fut.result(timeout=30.0)
+        sid = srv._m._sid
+        deadline = time.time() + 10.0
+        err = []
+        while time.time() < deadline and not err:
+            err = [f for f in os.listdir(str(tmp_path))
+                   if '_error_%s' % sid in f]
+            time.sleep(0.01)
+        assert err, 'tagged dispatch dump missing'
+    finally:
+        srv.close()
+        timeline.reset()

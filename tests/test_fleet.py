@@ -213,7 +213,7 @@ def test_fleet_default_replicas_flag(versions, monkeypatch):
 # -- failure containment ----------------------------------------------
 def _break(rep):
     """Make a replica's dispatch path fail (simulated dead process)."""
-    def boom(feed):
+    def boom(feed, **kw):  # accepts request_id= like the real submit
         raise OSError("replica %s: injected dispatch failure" % rep.rid)
     rep.server.submit = boom
 
@@ -503,3 +503,109 @@ def test_callback_gauge_primitive():
     assert child.value == 7.0
     snap = reg.snapshot()
     assert snap['paddle_tpu_test_cb_gauge']['samples'][0]['value'] == 7.0
+
+
+# -- HBM observability PR: resident-bytes gauges + budget precheck --------
+
+def test_resident_bytes_gauges_and_shared_dedupe(versions):
+    fleet = _mk_fleet(versions, replicas=2)
+    try:
+        st = fleet.stats()
+        per = st['replicas']
+        assert all(p['resident_bytes'] > 0 for p in per)
+        # replicas of one version share ONE compiled servable: the
+        # aggregate counts it once, not once per dispatch lane
+        assert st['resident_bytes'] == per[0]['resident_bytes']
+        assert st['resident_bytes_watermark'] >= st['resident_bytes']
+        # per-replica gauge series exist, labeled fleet/replica/version
+        fam = fleet._m._resident
+        for rep in fleet._replicas:
+            assert rep.m_resident.value == \
+                rep.resident['total_bytes'] > 0
+        # the aggregate callback gauge reads the deduped total live
+        agg = fleet._m._g_resident.labels(fleet=fleet._fid)
+        assert agg.value == st['resident_bytes']
+    finally:
+        fleet.close()
+
+
+def test_deploy_overlap_raises_resident_watermark(versions):
+    fleet = _mk_fleet(versions, replicas=2, version='1')
+    try:
+        v1 = fleet.stats()['resident_bytes']
+        fleet.deploy(versions, version='2')
+        st = fleet.stats()
+        # at the rollout overlap both versions were live: the
+        # watermark saw more than either steady state alone
+        assert st['resident_bytes_watermark'] > st['resident_bytes']
+        assert st['resident_bytes_watermark'] > v1
+    finally:
+        fleet.close()
+
+
+def test_hbm_budget_precheck_is_warn_only(versions, caplog):
+    import logging
+    fleet = _mk_fleet(versions, replicas=1, version='1')
+    try:
+        before = fleet.stats()
+        assert before['hbm_budget_precheck_failures'] == 0
+        with caplog.at_level(logging.WARNING,
+                             logger='paddle_tpu.inference.fleet'):
+            vname = fleet.deploy(versions, version='2',
+                                 hbm_budget_bytes=1)
+        assert vname == '2'  # warn-only: the deploy went through
+        st = fleet.stats()
+        assert st['hbm_budget_precheck_failures'] == 1
+        assert any('would exceed the HBM budget' in r.message
+                   for r in caplog.records)
+        # and the fleet still serves the new version
+        rng = np.random.RandomState(1)
+        out, = fleet.predict(_feed(rng), timeout=30.0)
+        assert out.shape == (1, 4)
+        # a roomy budget passes silently
+        fleet.deploy(versions, version='1',
+                     hbm_budget_bytes=1 << 40)
+        assert fleet.stats()['hbm_budget_precheck_failures'] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_budget_defaults_to_peak_hbm_flag(versions, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_PEAK_HBM_BYTES', '1')
+    fleet = _mk_fleet(versions, replicas=1)  # ctor deploy prechecks
+    try:
+        st = fleet.stats()
+        assert st['hbm_budget_bytes'] == 1
+        assert st['hbm_budget_precheck_failures'] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_routing_span_carries_request_id(versions, monkeypatch,
+                                               tmp_path):
+    from paddle_tpu.observability import timeline
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    timeline.reset()
+    fleet = _mk_fleet(versions, replicas=2)
+    try:
+        rng = np.random.RandomState(2)
+        out, = fleet.predict(_feed(rng), timeout=30.0)
+        deadline = time.time() + 10.0
+        disp = qw = None
+        while time.time() < deadline and not (disp and qw):
+            evs = timeline.ring().events()
+            disp = [e for e in evs
+                    if e['name'] == 'fleet.dispatch'] or None
+            qw = [e for e in evs
+                  if e['name'] == 'serving.queue_wait'] or None
+            time.sleep(0.01)
+        assert disp, 'fleet routing span missing'
+        assert qw, 'replica queue-wait span missing'
+        rid = disp[0]['args']['request_id']
+        assert disp[0]['args']['replica'] in fleet.replica_ids
+        assert disp[0]['args']['version'] == fleet.version
+        # ONE id names the request across routing and replica spans
+        assert any(e['args'].get('request_id') == rid for e in qw)
+    finally:
+        fleet.close()
+        timeline.reset()

@@ -31,11 +31,11 @@ def test_registered_passes_surface():
     from paddle_tpu.transpiler import pass_manager as pm
     names = [p.name for p in pm.registered_passes()]
     assert names == ['dce', 'constant_fold', 'cse', 'dce_sweep', 'amp',
-                     'donation', 'cost_model']
+                     'donation', 'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(1, None)] == [
-        'dce', 'donation', 'cost_model']
+        'dce', 'donation', 'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(0, 'bf16')] == ['amp']
     assert [p.name for p in pm.build_plan(2, 'bf16')] == [
         'dce', 'constant_fold', 'cse', 'dce_sweep', 'amp', 'donation',
-        'cost_model']
+        'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(0, None)] == []

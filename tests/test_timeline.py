@@ -274,3 +274,82 @@ def test_disarmed_executor_records_nothing(monkeypatch):
     cats = {e['cat'] for e in timeline.ring().events()}
     assert 'feed' not in cats and 'compute' not in cats \
         and 'update' not in cats
+
+
+# -- memory counter track (HBM observability PR) ---------------------------
+
+def test_memory_counter_track_schema(tmp_path, monkeypatch):
+    """The exported trace carries a loadable ``ph:"C"`` counter track:
+    the modeled live-bytes sawtooth, whose max equals the memory
+    model's reported peak, each sample a numeric args['bytes']."""
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    timeline.reload_armed()
+    exe = _run_steps(k=3)
+    files = [f for f in os.listdir(str(tmp_path))
+             if f.endswith('.json')]
+    doc = json.load(open(str(tmp_path / files[0])))
+    counters = [e for e in doc['traceEvents'] if e.get('ph') == 'C']
+    assert counters, 'no counter events in the exported trace'
+    modeled = [e for e in counters
+               if e['name'] == 'paddle_tpu.modeled_live_bytes']
+    assert modeled, 'modeled live-bytes track missing'
+    for e in modeled:
+        assert e['cat'] == 'memory'
+        assert isinstance(e['args']['bytes'], int)
+        assert 'dur' not in e  # counters are instants, not spans
+        # a second args key would render as a stray series
+        assert set(e['args']) == {'bytes'}
+    peak = exe.last_step_report['memory']['modeled_peak_bytes']
+    assert max(e['args']['bytes'] for e in modeled) == peak
+    # CPU backend: no measured track, honestly absent (not zeros)
+    assert not [e for e in counters
+                if e['name'] == 'paddle_tpu.device_bytes_in_use']
+
+
+def test_counter_downsampling_keeps_the_peak():
+    tl = timeline.Timeline(cap=None)
+    n = 500
+    peak_i = 333
+    pts = [{'op_seq': i, 'live_bytes': 10 + (10 ** 6 if i == peak_i
+                                             else i % 7)}
+           for i in range(n)]
+    fluid.Executor._emit_memory_counters(
+        tl, {'timeline': pts}, t0=0.0, span=1.0)
+    evs = [e for e in tl.events() if e.get('ph') == 'C']
+    assert 0 < len(evs) <= 100
+    assert max(e['args']['bytes'] for e in evs) == 10 + 10 ** 6
+
+
+def test_timeline_cli_summarizes_trace(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    timeline.reload_armed()
+    _run_steps(k=2)
+    files = [f for f in os.listdir(str(tmp_path))
+             if f.endswith('.json')]
+    path = str(tmp_path / files[0])
+    rc = timeline._cli([path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert 'top phases by total wall' in out
+    assert 'executor.' in out
+    assert 'per-step phase walls' in out
+    assert 'counter tracks' in out
+    assert 'paddle_tpu.modeled_live_bytes.bytes' in out
+
+
+def test_summarize_trace_empty_doc():
+    lines = timeline.summarize_trace({'traceEvents': []})
+    assert any('no span or counter events' in ln for ln in lines)
+
+
+def test_dump_on_error_tag_lands_in_filename(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DIR', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_TRACE_DUMP_ON_ERROR', '1')
+    timeline.reload_armed()
+    timeline.record('something', cat='user', dur=0.001)
+    path = timeline.maybe_dump_on_error(tag='b7/v1 x')
+    assert path is not None
+    base = os.path.basename(path)
+    # tag is filename-sanitized, never a path traversal
+    assert base == 'trace_%d_error_b7_v1_x.json' % os.getpid()
+    assert json.load(open(path))['traceEvents']

@@ -396,13 +396,14 @@ def test_per_pass_report_structure(monkeypatch):
     rep = exe.last_graph_opt_report
     names = [e['name'] for e in rep['passes']]
     assert names == ['dce', 'constant_fold', 'cse', 'dce_sweep',
-                     'donation', 'cost_model']
+                     'donation', 'cost_model', 'memory_model']
     for e in rep['passes']:
         assert e['status'] == 'ok'
         assert e['ops_after'] <= e['ops_before']
         assert e['wall_s'] >= 0.0
-        assert e['verify'] == ('ok' if e['name'] not in
-                               ('donation', 'cost_model') else 'skipped')
+        assert e['verify'] == (
+            'ok' if e['name'] not in
+            ('donation', 'cost_model', 'memory_model') else 'skipped')
     assert rep['verify']['mode'] == 'every_pass'
     assert rep['verify']['checks'] == 4  # one per rewrite pass
 
