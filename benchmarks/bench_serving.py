@@ -189,6 +189,7 @@ def main():
     results.extend(dynamic_scenario(tpu))
     results.extend(amp_scenario(tpu))
     results.extend(fleet_scenario(tpu))
+    results.extend(online_scenario(tpu))
     # attach the observability snapshot so BENCH_*.json runs carry the
     # queue/occupancy/latency telemetry behind the headline numbers
     # (empty when PADDLE_TPU_METRICS_ENABLED=0 — servers then report to
@@ -477,6 +478,334 @@ def _fleet_scenario_impl(tpu):
             "compile threads don't contend with serving.")
     print(json.dumps(summary))
     results.append(summary)
+    fleet.close()
+    return results
+
+
+def online_scenario(tpu):
+    """The continuous-learning drill (ROADMAP item 4): Poisson traffic
+    against a fleet while the online pipeline retrains it in the SAME
+    process —
+
+      steady -> concept drift (label coupling rotates mid-run; the
+      serving model goes stale and background fine-tune rounds win it
+      back through the eval gate) -> one injected bad round (a
+      poisoned, label-flipped log segment force-promoted past the
+      gate, simulating a corrupted upstream joiner) -> automatic
+      rollback on the live-AUC regression -> recovery
+
+    — recording per-phase serving p99, the live-AUC-over-time and
+    model-age series, the freshness-SLO violation count, and the
+    failed-request count (the bar is ZERO: deploys drain, rollbacks
+    drain, training steals no request).
+
+    On the CPU smoke box the mid-phase p99 tail includes each promote's
+    export + warmup compiles contending with the two serving cores
+    (the fleet_scenario note applies); on a TPU host the compile
+    threads don't contend with serving.
+    """
+    import paddle_tpu as fluid
+    from paddle_tpu.core.program import reset_unique_name_guard
+    from paddle_tpu.inference import ServingFleet, export_bucketed
+    from paddle_tpu.online import (ClickstreamTail, ClickstreamWriter,
+                                   OnlineController, OnlineTrainer)
+    from paddle_tpu import io as pio
+
+    n_dense, n_slots, id_space = 13, 4, 5000
+    batch, steps, holdout = 16, 6, 2       # 96 train + 32 gate rows
+    poison_steps = 24                      # the bad round trains 4x
+    max_batch, replicas = 4, 2
+    live_window = 96
+    slo_s = 8.0
+    base = tempfile.mkdtemp(prefix='paddle_tpu_online_')
+    log = os.path.join(base, 'click.log')
+
+    with reset_unique_name_guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        main_prog.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main_prog, startup):
+            dense = fluid.layers.data(name='dense', shape=[n_dense],
+                                      dtype='float32')
+            slots = [fluid.layers.data(name='C%d' % i, shape=[1],
+                                       dtype='int64')
+                     for i in range(n_slots)]
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            embs = [fluid.layers.embedding(input=s,
+                                           size=[id_space, 8])
+                    for s in slots]
+            feat = fluid.layers.concat(embs + [dense], axis=1)
+            h = fluid.layers.fc(input=feat, size=32, act='relu')
+            predict = fluid.layers.fc(input=h, size=2, act='softmax')
+            cost = fluid.layers.cross_entropy(input=predict,
+                                              label=label)
+            loss = fluid.layers.mean(x=cost)
+            fluid.optimizer.AdamOptimizer(
+                learning_rate=0.01).minimize(loss)
+        infer_prog = pio.get_inference_program([predict], main_prog)
+    place = fluid.TPUPlace(0) if tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    def batch_fn(rows):
+        f = {'dense': np.stack([r[0] for r in rows]),
+             'label': np.array([[r[2]] for r in rows],
+                               dtype=np.int64)}
+        for i in range(n_slots):
+            f['C%d' % i] = np.array([[r[1][i]] for r in rows],
+                                    dtype=np.int64)
+        return f
+
+    def request_feed(row):
+        f = {'dense': row[0][None, :]}
+        for i in range(n_slots):
+            f['C%d' % i] = np.array([[row[1][i]]], dtype=np.int64)
+        return f
+
+    writer = ClickstreamWriter(log, n_dense=n_dense, n_slots=n_slots,
+                               id_space=id_space, seed=0)
+    world = {'drift': 0.0}            # shared by log AND traffic
+    writer.append(batch * (steps + holdout) * 4)  # pretrain backlog
+    tail = ClickstreamTail(log)
+    trainer = OnlineTrainer(
+        exe, main_prog, tail, batch_fn, batch_size=batch,
+        checkpoint_dir=os.path.join(base, 'ckpt'),
+        steps_per_round=steps, holdout_batches=holdout,
+        fetch_list=[loss], scope=scope)
+    for _ in range(4):                # pretrain off the backlog
+        trainer.run_round(max_wait_s=5.0)
+
+    specs = {'dense': (n_dense,)}
+    specs.update({('C%d' % i): (1,) for i in range(n_slots)})
+    export_base = os.path.join(base, 'versions')
+
+    def export_fn(vdir):
+        export_bucketed(vdir, specs, [predict], executor=exe,
+                        main_program=main_prog, scope=scope,
+                        max_batch=max_batch)
+
+    os.makedirs(export_base)
+    export_fn(os.path.join(export_base, '1'))
+    t0_fleet = time.perf_counter()
+    fleet = ServingFleet(export_base, replicas=replicas,
+                         max_wait_ms=10.0, linger_ms=0.3,
+                         health_interval_ms=100.0)
+    warmup_s = time.perf_counter() - t0_fleet
+
+    def eval_fn(rows):
+        feed = batch_fn(rows)
+        feed.pop('label')
+        out = exe.run(infer_prog, feed=feed, fetch_list=[predict],
+                      scope=scope)[0]
+        return np.asarray(out)[:, 1], np.array([r[2] for r in rows])
+
+    def serving_eval_fn(rows):
+        futs = [fleet.submit(request_feed(r)) for r in rows]
+        scores = [float(np.asarray(f.result(timeout=60.0)[0])[0, 1])
+                  for f in futs]
+        return np.array(scores), np.array([r[2] for r in rows])
+
+    ctl = OnlineController(
+        trainer, fleet, export_base, export_fn, eval_fn,
+        serving_eval_fn=serving_eval_fn, live_window=live_window,
+        freshness_slo_s=slo_s, auc_delta=0.05)
+
+    # offered load: a fraction of the sequential predict rate, like
+    # fleet_scenario — enough pressure that batching matters, stable
+    # on the smoke box while compiles contend
+    probe = request_feed(writer.make_row())
+    for _ in range(16):
+        fleet.submit(probe)
+    fleet.predict(probe)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        fleet.predict(probe)
+    lam = 0.7 * 30 / (time.perf_counter() - t0)
+
+    # background feedback traffic: Poisson arrivals scored by the
+    # fleet; each outcome (score, true label) feeds the live monitor
+    lat, errors = [], []            # (t_done, phase, latency_s)
+    phase = ['steady']
+    stop = threading.Event()
+    pause_writer = threading.Event()
+    rng = np.random.default_rng(1)
+
+    def traffic():
+        while not stop.is_set():
+            time.sleep(float(rng.exponential(1.0 / lam)))
+            row = writer.make_row(world['drift'])
+            t_sub = time.perf_counter()
+            ph = phase[0]
+            try:
+                fut = fleet.submit(request_feed(row))
+            except Exception as e:
+                errors.append(e)
+                continue
+
+            def done(f, t_sub=t_sub, ph=ph, y=row[2]):
+                t_done = time.perf_counter()
+                if f.exception() is not None:
+                    errors.append(f.exception())
+                    return
+                s = float(np.asarray(f.result()[0])[0, 1])
+                lat.append((t_done, ph, t_done - t_sub))
+                ctl.record_live([s], [y])
+            fut.add_done_callback(done)
+
+    def feed_log():
+        # ~160 rows/s: roughly the loop's consumption rate, so the
+        # trainer stays near the tail (run_rounds also drops any
+        # backlog before each round — freshness first)
+        while not stop.is_set():
+            if not pause_writer.is_set():
+                writer.append(16, drift=world['drift'])
+            time.sleep(0.1)
+
+    def p99_ms(ph=None, window_s=None):
+        now = time.perf_counter()
+        xs = [l * 1e3 for t, p, l in lat
+              if (ph is None or p == ph)
+              and (window_s is None or now - t <= window_s)]
+        return float(np.percentile(xs, 99)) if len(xs) >= 20 else None
+
+    series, round_log = [], []
+
+    def sample(tag=''):
+        st = ctl.stats()
+        series.append({
+            't': round(time.perf_counter() - t_start, 2),
+            'phase': phase[0], 'tag': tag,
+            'version': st['version'],
+            'live_auc': None if st['live_auc'] is None
+            else round(st['live_auc'], 4),
+            'model_age_s': round(st['model_age_s'], 2),
+            'in_violation': st['in_violation'],
+            'p99_ms_30s': None if p99_ms(window_s=30.0) is None
+            else round(p99_ms(window_s=30.0), 2)})
+
+    def run_rounds(n, force=False):
+        for _ in range(n):
+            # freshness first: a loop that fell behind trains on the
+            # newest window, not the stale backlog (skipped rows are
+            # accounted exactly like gate-rejected ones)
+            tail.skip_to_latest(keep_bytes=64_000)
+            # let the live window fill with the CURRENT version's
+            # outcomes so check() judges it, not its predecessor
+            time.sleep(0.3)
+            sample('pre')  # the SERVING model's live AUC, pre-swap
+            rep = ctl.run_round(max_wait_s=30.0, force_promote=force)
+            gate = rep.get('gate') or {}
+            round_log.append({
+                'phase': phase[0], 'outcome': rep['outcome'],
+                'step': rep['step'],
+                'gate_auc': None if 'auc' not in gate
+                else round(gate['auc'], 4),
+                'serving_auc': None if gate.get('serving_auc') is None
+                else round(gate['serving_auc'], 4),
+                'version': rep.get('version'),
+                'round_s': round(rep['round_s'], 2)})
+            ctl.check(p99_ms=p99_ms(window_s=30.0))
+            sample('round')
+
+    threads = [threading.Thread(target=traffic, daemon=True),
+               threading.Thread(target=feed_log, daemon=True)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    results = []
+    try:
+        # -- steady: the loop promotes fresh models under load -------
+        run_rounds(2)
+
+        # -- drift: the label coupling rotates; the serving model is
+        # now stale and retraining wins it back through the gate -----
+        phase[0] = 'drift'
+        world['drift'] = 0.45
+        run_rounds(4)
+
+        # -- poison: a corrupted upstream segment (labels flipped),
+        # force-promoted past the gate — the injected bad round ------
+        phase[0] = 'poison'
+        pause_writer.set()
+        tail.skip_to_latest()  # the poisoned segment is what's next
+        trainer.steps_per_round = poison_steps  # one big bad round
+        writer.append(batch * (poison_steps + holdout),
+                      drift=world['drift'], flip_labels=True)
+        run_rounds(1, force=True)
+        trainer.steps_per_round = steps
+        pause_writer.clear()
+        # the live window fills with the bad model's outcomes; the
+        # watchdog rolls back automatically
+        deadline = time.perf_counter() + 60.0
+        fired = None
+        while fired is None and time.perf_counter() < deadline:
+            time.sleep(0.3)
+            fired = ctl.check(p99_ms=p99_ms(window_s=30.0))
+        sample('rollback' if fired else 'rollback_timeout')
+
+        # -- recovery: clean rounds promote again --------------------
+        phase[0] = 'recovery'
+        run_rounds(2)
+
+        # -- stall: an upstream log outage — no fresh rows, so no
+        # promotes, and the serving model ages past the freshness SLO
+        # (the counted, alertable violation window); the next promote
+        # after the log recovers clears it ---------------------------
+        phase[0] = 'stall'
+        pause_writer.set()
+        t_stall = time.perf_counter()
+        while time.perf_counter() - t_stall < slo_s * 1.3:
+            time.sleep(0.5)
+            ctl.check(p99_ms=p99_ms(window_s=30.0))
+        sample('stalled')
+        pause_writer.clear()
+        run_rounds(1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+
+    st = ctl.stats()
+    fst = fleet.stats()
+    p99_steady = p99_ms('steady')
+    per_phase = {ph: p99_ms(ph) for ph in
+                 ('steady', 'drift', 'poison', 'recovery', 'stall')}
+    summary = {
+        'metric': 'ctr_online_loop_summary',
+        'value': len(errors), 'unit': 'failed requests',
+        'offered_req_s': round(lam, 1),
+        'replicas': replicas, 'fleet_warmup_s': round(warmup_s, 1),
+        'rounds': [r for r in round_log],
+        'rounds_promoted': sum(1 for r in round_log
+                               if r['outcome'] == 'promoted'),
+        'rounds_gate_failed': sum(1 for r in round_log
+                                  if r['outcome'] == 'gate_failed'),
+        'auto_rollback_reason': st['last_rollback_reason'],
+        'rollbacks_by_reason': fst['rollbacks_by_reason'],
+        'freshness_slo_s': slo_s,
+        'slo_violations': st['slo_violations'],
+        'final_version': st['version'],
+        'final_live_auc': None if st['live_auc'] is None
+        else round(st['live_auc'], 4),
+        'p99_ms_by_phase': {k: (None if v is None else round(v, 2))
+                            for k, v in per_phase.items()},
+        'p99_worst_over_steady': None if not p99_steady else round(
+            max(v for v in per_phase.values() if v is not None)
+            / p99_steady, 2),
+        'requests': fst['requests'], 'failed': fst['failed'],
+        'series': series,
+    }
+    if not tpu:
+        summary['note'] = (
+            '2-core CPU smoke box: promote-phase p99 tails include '
+            'each export + deploy warmup compiling on the serving '
+            'cores (same structural contention as the fleet swap '
+            'phase); on a TPU host compiles do not contend with '
+            'serving.')
+    print(json.dumps(summary))
+    results.append(summary)
+    ctl.close()
     fleet.close()
     return results
 

@@ -11,7 +11,7 @@ from .core.program import Program, Variable, unique_name
 from .initializer import ConstantInitializer
 from .layers.layer_helper import LayerHelper
 
-__all__ = ['Accuracy', 'ChunkEvaluator', 'Evaluator']
+__all__ = ['Accuracy', 'ChunkEvaluator', 'Evaluator', 'StreamingAUC']
 
 
 def _clone_var_(block, var):
@@ -48,6 +48,109 @@ class Evaluator(object):
         self.helper.set_variable_initializer(state, ConstantInitializer(0.0))
         self.states.append(state)
         return state
+
+
+class StreamingAUC(object):
+    """Mergeable streaming AUC over a fixed-bin rank histogram.
+
+    Scores land in ``bins`` equal-width bins over ``[lo, hi]``; the
+    evaluator keeps one positive and one negative count per bin, so the
+    whole state is two int64 vectors — O(bins) memory regardless of how
+    many samples stream through, updates from any thread or process can
+    be :meth:`merge`\\ d exactly (bin counts add), and :meth:`eval` is
+    the Mann-Whitney rank statistic over the histogram:
+
+        AUC = sum_b pos_b * (neg_below_b + neg_b / 2) / (P * N)
+
+    which equals the EXACT pairwise AUC of the samples with scores
+    quantized to their bins (same-bin pairs count 1/2, the standard tie
+    convention) — so the only approximation is the score quantization,
+    bounded by the bin width.  This is the ONE AUC implementation the
+    online-training eval gate and the live-traffic monitor share
+    (``paddle_tpu/online/controller.py``): a gate verdict and the
+    post-deploy regression check are never comparing two different
+    definitions of the metric.
+
+    Update/merge order is irrelevant (integer adds), so chunked
+    updates, a one-shot update, and a merge of per-worker partials are
+    bitwise-identical — the property the golden tests pin.
+    """
+
+    __slots__ = ('bins', 'lo', 'hi', '_pos', '_neg')
+
+    def __init__(self, bins=2048, lo=0.0, hi=1.0):
+        if bins < 2:
+            raise ValueError("StreamingAUC needs >= 2 bins, got %d"
+                             % bins)
+        if not hi > lo:
+            raise ValueError("StreamingAUC needs hi > lo, got [%r, %r]"
+                             % (lo, hi))
+        self.bins = int(bins)
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self._pos = np.zeros(self.bins, dtype=np.int64)
+        self._neg = np.zeros(self.bins, dtype=np.int64)
+
+    def update(self, scores, labels):
+        """Accumulate a batch: ``scores`` float-like, ``labels`` 0/1
+        (anything nonzero counts positive).  Out-of-range scores clamp
+        to the edge bins.  Returns self (chainable)."""
+        s = np.asarray(scores, dtype=np.float64).reshape(-1)
+        y = np.asarray(labels).reshape(-1)
+        if s.shape != y.shape:
+            raise ValueError(
+                "scores and labels disagree: %d vs %d samples"
+                % (s.size, y.size))
+        if s.size == 0:
+            return self
+        idx = ((s - self.lo) * (self.bins / (self.hi - self.lo)))
+        idx = np.clip(idx.astype(np.int64), 0, self.bins - 1)
+        pos = y != 0
+        self._pos += np.bincount(idx[pos], minlength=self.bins)
+        self._neg += np.bincount(idx[~pos], minlength=self.bins)
+        return self
+
+    def merge(self, other):
+        """Fold another StreamingAUC's counts into this one (exact:
+        histograms add).  Bin layouts must match."""
+        if (other.bins, other.lo, other.hi) != (self.bins, self.lo,
+                                                self.hi):
+            raise ValueError(
+                "cannot merge StreamingAUC(bins=%d, [%r, %r]) into "
+                "(bins=%d, [%r, %r])" % (other.bins, other.lo, other.hi,
+                                         self.bins, self.lo, self.hi))
+        self._pos += other._pos
+        self._neg += other._neg
+        return self
+
+    def eval(self):
+        """AUC of everything accumulated so far; 0.5 when either class
+        is empty (undefined — the neutral value keeps gate arithmetic
+        total)."""
+        p = int(self._pos.sum())
+        n = int(self._neg.sum())
+        if p == 0 or n == 0:
+            return 0.5
+        neg_below = np.cumsum(self._neg) - self._neg
+        num = float(np.sum(self._pos * (neg_below + self._neg * 0.5)))
+        return num / (float(p) * float(n))
+
+    @property
+    def count(self):
+        return int(self._pos.sum() + self._neg.sum())
+
+    @property
+    def positives(self):
+        return int(self._pos.sum())
+
+    @property
+    def negatives(self):
+        return int(self._neg.sum())
+
+    def reset(self):
+        self._pos[:] = 0
+        self._neg[:] = 0
+        return self
 
 
 class Accuracy(Evaluator):

@@ -258,6 +258,47 @@ DEFINE_int('peak_hbm_bytes', 0,
            'still reports absolute bytes either way.  Set it to the '
            'chip HBM size (e.g. 16 GiB for a v5e core) minus whatever '
            'reserve the runtime claims')
+DEFINE_int('online_round_rows', 256,
+           'rows per online fine-tune round (paddle_tpu.online.'
+           'OnlineTrainer): a round fires once this many clickstream '
+           'rows are available (rounded down to whole batches; the '
+           'remainder stays unconsumed in the log).  Explicit '
+           'steps_per_round= on the trainer overrides it')
+DEFINE_float('online_round_window_s', 0.0,
+             'time trigger for online fine-tune rounds: when >0, a '
+             'round also fires after this many seconds of collecting '
+             'even if fewer than PADDLE_TPU_ONLINE_ROUND_ROWS rows '
+             'arrived (at least one full batch is still required).  '
+             '0 (default) triggers on row count only')
+DEFINE_float('online_poll_ms', 25.0,
+             'poll period of the clickstream tail reader '
+             '(paddle_tpu.online.stream) while waiting for new rows '
+             'to be appended to the log')
+DEFINE_float('online_auc_floor', 0.55,
+             'eval-gate floor for the online controller: a fine-tune '
+             'round whose holdout AUC is below this is rejected (the '
+             'round\'s checkpoint is rolled back, nothing is '
+             'deployed)')
+DEFINE_float('online_auc_delta', 0.02,
+             'eval-gate regression margin: a candidate whose holdout '
+             'AUC is more than this below the serving model\'s AUC on '
+             'the SAME holdout is rejected even when it clears the '
+             'floor')
+DEFINE_float('online_freshness_slo_s', 0.0,
+             'freshness SLO for the online-serving loop: when >0, the '
+             'controller counts a violation '
+             '(paddle_tpu_online_freshness_slo_violations_total) '
+             'whenever the serving model\'s age — time since the data '
+             'its version was trained on — exceeds this many seconds, '
+             'and the /healthz endpoint reports degraded for the '
+             'duration.  The age itself is always exported as the '
+             'paddle_tpu_online_model_age_seconds gauge.  0 (default) '
+             'disables the SLO check')
+DEFINE_int('online_keep_versions', 4,
+           'export-dir retention for promoted online versions: after '
+           'each promote, io.gc_versions prunes numbered version dirs '
+           'beyond the newest N, never touching the fleet\'s live '
+           'version or its .prev rollback target')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -196,10 +196,16 @@ class _FleetMetrics(object):
         self.deploys = child(reg.counter(
             'paddle_tpu_fleet_deploys_total',
             'version deployments (hot-swaps) completed', L))
-        self.rollbacks = child(reg.counter(
+        # reason-labeled: the controller's automatic rollbacks
+        # (live_auc_regression, p99_regression, ...) are
+        # distinguishable from an operator's explicit call in /metrics
+        self._rollbacks = reg.counter(
             'paddle_tpu_fleet_rollbacks_total',
             'deployments that were rollbacks to the archived previous '
-            'version', L))
+            'version, by reason ("operator" = explicit call; automated '
+            'callers pass their trigger, e.g. live_auc_regression)',
+            ('fleet', 'reason'))
+        self._rollback_reason_kvs = []
         self.unroutable_marks = child(reg.counter(
             'paddle_tpu_fleet_unroutable_marks_total',
             'replica transitions into the unroutable state', L))
@@ -266,6 +272,14 @@ class _FleetMetrics(object):
             'deploy-overlap moments (old + incoming version both '
             'live) included', L))
 
+    def rollback_inc(self, reason):
+        """Count one rollback under its reason label (child tracked so
+        close() retires the series)."""
+        kv = dict(fleet=self._fid, reason=str(reason))
+        self._rollbacks.labels(**kv).inc()
+        if kv not in self._rollback_reason_kvs:
+            self._rollback_reason_kvs.append(kv)
+
     def bind(self, rep):
         """Create (and attach) the per-replica counter children."""
         kv = dict(fleet=self._fid, replica=rep.rid, version=rep.version)
@@ -294,6 +308,9 @@ class _FleetMetrics(object):
         for fam, kv in self._replica_families:
             fam.remove(**kv)
         self._replica_families = []
+        for kv in self._rollback_reason_kvs:
+            self._rollbacks.remove(**kv)
+        self._rollback_reason_kvs = []
         for st in self._replica_state_labels:
             self._g_replicas.remove(fleet=self._fid, state=st)
 
@@ -365,6 +382,8 @@ class ServingFleet(object):
         self._version_dir = None
         self._deploy_seq = 0
         self._closed = False
+        self._rollbacks_by_reason = {}   # reason -> count (stats())
+        self._last_deploy_reason = None
 
         self._owned_state_dir = None
         if state_dir is None:
@@ -663,7 +682,7 @@ class ServingFleet(object):
 
     # -- versioned deployment ------------------------------------------
     def deploy(self, version_dir, replicas=None, version=None,
-               hbm_budget_bytes=None):
+               hbm_budget_bytes=None, reason='operator'):
         """Hot-swap the model version with zero dropped requests:
 
         1. resolve ``version_dir`` (``io.resolve_version_dir``);
@@ -682,7 +701,12 @@ class ServingFleet(object):
 
         Returns the deployed version name.  Serialized against
         concurrent deploy/add/remove; client submits never block on
-        it."""
+        it.  ``reason`` is a short string recorded in the deployment
+        record and ``stats()['last_deploy_reason']`` — operator calls
+        default to ``'operator'``; automated callers (the online
+        controller's promote/rollback) pass their trigger so the
+        metrics and the on-disk record say WHY a version flip
+        happened."""
         with self._deploy_lock:
             vdir, vname = _io.resolve_version_dir(version_dir, version)
             paths = _io.bucket_artifacts(vdir)
@@ -731,26 +755,52 @@ class ServingFleet(object):
                 raise RuntimeError("ServingFleet is closed")
             _io.write_rollback_json(self._deploy_record, {
                 'version': vname, 'dir': os.path.abspath(vdir),
-                'replicas': n, 'seq': seq})
+                'replicas': n, 'seq': seq, 'reason': str(reason)})
+            with self._lock:
+                self._last_deploy_reason = str(reason)
             self._m.deploys.inc()
             self._retire(old)
             return vname
 
-    def rollback(self):
+    def rollback(self, reason='operator'):
         """Hot-swap back to the previous deployment, read from the
         ``.prev`` archive of the deploy record (the io.py manifest/
         ``.prev`` protocol).  Two rollbacks in a row toggle between the
         last two versions — each deploy re-archives what it replaced.
-        Returns the restored version name."""
+        Returns the restored version name.
+
+        ``reason`` labels the rollback in
+        ``paddle_tpu_fleet_rollbacks_total{reason=...}`` (and the new
+        deployment record): ``'operator'`` for a human's explicit call,
+        automated callers pass their trigger
+        (``'live_auc_regression'``, ``'p99_regression'``, ...) so a
+        dashboard can tell a controller's reflex from a person's
+        decision."""
         rec = _io.read_rollback_json(self._deploy_record, prev=True)
         if rec is None:
             raise RuntimeError(
                 "fleet %s has no previous deployment to roll back to "
                 "(the deploy record has no .prev archive yet)"
                 % self._fid)
-        self._m.rollbacks.inc()
-        return self.deploy(rec['dir'],
-                           replicas=rec.get('replicas'))
+        reason = str(reason)
+        restored = self.deploy(rec['dir'], replicas=rec.get('replicas'),
+                               reason='rollback:%s' % reason)
+        # counted only once the restore actually serves — a rollback
+        # whose deploy failed (archived dir gone, raced close()) must
+        # not read as a completed recovery in /metrics
+        self._m.rollback_inc(reason)
+        with self._lock:
+            self._rollbacks_by_reason[reason] = \
+                self._rollbacks_by_reason.get(reason, 0) + 1
+        return restored
+
+    def deployment(self, prev=False):
+        """The on-disk deployment record ({version, dir, replicas,
+        seq, reason}), or its ``.prev`` archive — the rollback target.
+        None when the requested record does not exist.  Public so
+        retention tooling (``io.gc_versions``) can protect exactly the
+        dirs the fleet may still resolve."""
+        return _io.read_rollback_json(self._deploy_record, prev=prev)
 
     # -- resident-bytes accounting -------------------------------------
     def _resident_total(self, extra=()):
@@ -847,6 +897,8 @@ class ServingFleet(object):
         with self._lock:
             reps = list(self._replicas)
             version = self._version
+            by_reason = dict(self._rollbacks_by_reason)
+            last_reason = self._last_deploy_reason
         per = []
         for r in reps:
             s = r.server.stats()
@@ -876,7 +928,9 @@ class ServingFleet(object):
             'failed': int(m.failed.value),
             'retries': int(m.retries.value),
             'deploys': int(m.deploys.value),
-            'rollbacks': int(m.rollbacks.value),
+            'rollbacks': sum(by_reason.values()),
+            'rollbacks_by_reason': by_reason,
+            'last_deploy_reason': last_reason,
             'unroutable_marks': int(m.unroutable_marks.value),
             'health_probes': int(m.probes.value),
             'resident_bytes': self._resident_total(),

@@ -43,7 +43,7 @@ __all__ = [
     'get_parameter_value', 'get_parameter_value_by_name', 'is_parameter',
     'is_persistable', 'save_checkpoint', 'load_checkpoint',
     'rollback_checkpoint', 'bucket_artifacts', 'resolve_version_dir',
-    'write_rollback_json', 'read_rollback_json',
+    'write_rollback_json', 'read_rollback_json', 'gc_versions',
 ]
 
 
@@ -945,6 +945,80 @@ def resolve_version_dir(path, version=None):
                     else (0, e))
     best = candidates[-1]
     return os.path.join(path, best), best
+
+
+def gc_versions(base_dir, keep=4, protect=()):
+    """Retention for a base directory of numbered servable versions
+    (the ``export_bucketed`` layout ``base/1``, ``base/2``, ...): keep
+    the ``keep`` numerically-newest version dirs, delete the rest.
+    Returns the list of version names removed.
+
+    A continuously-promoting online pipeline mints a new version every
+    promoted round; without GC the export dir grows one full artifact
+    set per round forever.  Three dirs are NEVER candidates, because a
+    serving fleet may be holding or about to resolve them:
+
+    - anything named in ``protect`` (version names like ``'7'`` or
+      directory paths — callers pass the fleet's live version dir and
+      the ``.prev`` rollback target from its deploy record, so an
+      auto-``rollback()`` always finds its artifacts on disk);
+    - the numerically-highest version, regardless of ``keep`` (a
+      concurrent ``deploy(base_dir)`` resolves the highest number
+      *before* loading it — ``keep`` is floored at 1 for the same
+      reason);
+    - non-version entries: non-numeric names (``canary/``) and dirs
+      without ``bucket_<N>.stablehlo`` artifacts (e.g. a version a
+      concurrent exporter is still writing — it has no artifacts yet,
+      so it is invisible here exactly like it is to
+      :func:`resolve_version_dir`).
+
+    Deletion is rename-then-remove: the dir is atomically renamed to a
+    non-numeric ``.gc.<pid>`` name first, so a concurrent
+    ``resolve_version_dir`` either sees the intact version dir or does
+    not see it at all — never a half-deleted dir that resolves but
+    whose artifact files vanish mid-load (the deploy->promote->gc race
+    the tests pin)."""
+    import shutil
+    keep = max(1, int(keep))
+    prot_names, prot_paths = set(), set()
+    for p in protect:
+        if p is None:
+            continue
+        p = str(p)
+        if os.sep in p or p == '.':
+            prot_paths.add(os.path.abspath(p.rstrip(os.sep)))
+            prot_names.add(os.path.basename(p.rstrip(os.sep)))
+        else:
+            prot_names.add(p)
+    try:
+        entries = os.listdir(base_dir)
+    except OSError:
+        return []
+    versions = []
+    tomb = re.compile(r'^\d+\.gc\.\d+$')
+    for e in entries:
+        d = os.path.join(base_dir, e)
+        if e.isdigit() and os.path.isdir(d) and bucket_artifacts(d):
+            versions.append((int(e), e, d))
+        elif tomb.match(e) and os.path.isdir(d):
+            # a half-deleted victim from an earlier GC that crashed
+            # between its rename and rmtree (or whose rmtree failed):
+            # finish the job, or the leak is permanent — tombstone
+            # names are non-numeric and would never be candidates
+            shutil.rmtree(d, ignore_errors=True)
+    versions.sort()
+    removed = []
+    for _num, name, d in versions[:-keep]:
+        if name in prot_names or os.path.abspath(d) in prot_paths:
+            continue
+        tomb = '%s.gc.%d' % (d, os.getpid())
+        try:
+            os.rename(d, tomb)
+        except OSError:
+            continue  # a concurrent GC (or deploy machinery) won it
+        shutil.rmtree(tomb, ignore_errors=True)
+        removed.append(name)
+    return removed
 
 
 # -- .prev-protocol JSON records (fleet deploy/rollback state) ------------

@@ -36,7 +36,9 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       enabled, registry, reload_enabled, set_enabled)
 from .tracing import span
 from .exporters import json_snapshot, prometheus_text
-from .http import MetricsHTTPServer, maybe_serve_from_env, serve_metrics
+from .http import (MetricsHTTPServer, healthz_report,
+                   maybe_serve_from_env, register_healthz,
+                   serve_metrics, unregister_healthz)
 from . import timeline
 
 __all__ = [
@@ -45,6 +47,7 @@ __all__ = [
     'enabled', 'set_enabled', 'reload_enabled', 'registry', 'span',
     'prometheus_text', 'json_snapshot', 'snapshot',
     'MetricsHTTPServer', 'serve_metrics', 'maybe_serve_from_env',
+    'register_healthz', 'unregister_healthz', 'healthz_report',
     'counter', 'gauge', 'histogram', 'timeline',
 ]
 

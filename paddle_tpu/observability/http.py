@@ -6,7 +6,18 @@ scraping) calls ``serve_metrics(port)`` — or sets
 — and a daemon thread answers:
 
 - ``GET /metrics``  -> Prometheus text exposition of the global registry
-- ``GET /healthz``  -> ``{"status": "ok", "uptime_s": ...}``
+- ``GET /healthz``  -> ``{"status": "ok"|"degraded", "uptime_s": ...}``
+
+``/healthz`` is extensible: any subsystem with a liveness-style SLO can
+:func:`register_healthz` a named check (``fn() -> (ok, detail)``) and
+the endpoint aggregates them — 200 while every check passes, 503 with
+the failing checks named while any fails, so a plain HTTP prober (an
+alertmanager blackbox target, a load balancer health page, a k8s
+readinessProbe) can page on conditions like "the serving model is
+older than its freshness SLO" (paddle_tpu/online) without parsing
+/metrics.  Do NOT wire it into a livenessProbe: a restart cannot make
+a stale model fresher — degradation here means "alert a human / hold
+new traffic", not "kill the process".
 
 stdlib ``http.server`` only: no web framework lands in the dependency
 set for a scrape endpoint that serves two GET routes.  The listener
@@ -20,7 +31,50 @@ import time
 from . import exporters as _exporters
 from .metrics import registry as _global_registry
 
-__all__ = ['MetricsHTTPServer', 'serve_metrics', 'maybe_serve_from_env']
+__all__ = ['MetricsHTTPServer', 'serve_metrics', 'maybe_serve_from_env',
+           'register_healthz', 'unregister_healthz', 'healthz_report']
+
+# name -> fn() -> (ok, detail): process-wide health checks aggregated
+# into /healthz.  A check that RAISES reports as failing (a broken
+# health probe is not healthy), never as a 500 — the endpoint must stay
+# answerable precisely when things are going wrong.
+_health_checks = {}
+_health_lock = threading.Lock()
+
+
+def register_healthz(name, fn):
+    """Register (or replace) a named /healthz check.  ``fn`` takes no
+    arguments and returns ``(ok: bool, detail)`` where ``detail`` is any
+    JSON-serializable context (an age, a threshold, a message).  Checks
+    run at request time on the endpoint's thread — keep them fast and
+    thread-safe."""
+    with _health_lock:
+        _health_checks[str(name)] = fn
+
+
+def unregister_healthz(name):
+    """Remove a /healthz check; unknown names are a no-op (shutdown
+    paths must be idempotent)."""
+    with _health_lock:
+        _health_checks.pop(str(name), None)
+
+
+def healthz_report():
+    """(all_ok, {name: {"ok": bool, "detail": ...}}) across every
+    registered check — the dict /healthz serves under ``"checks"``."""
+    with _health_lock:
+        checks = list(_health_checks.items())
+    out = {}
+    all_ok = True
+    for name, fn in checks:
+        try:
+            ok, detail = fn()
+            ok = bool(ok)
+        except Exception as e:  # a crashing check is a failing check
+            ok, detail = False, 'check raised: %s' % (e,)
+        all_ok = all_ok and ok
+        out[name] = {'ok': ok, 'detail': detail}
+    return all_ok, out
 
 
 class MetricsHTTPServer(object):
@@ -43,12 +97,14 @@ class MetricsHTTPServer(object):
                     ctype = 'text/plain; version=0.0.4; charset=utf-8'
                     code = 200
                 elif path in ('/healthz', '/health'):
-                    body = (json.dumps(
-                        {'status': 'ok',
-                         'uptime_s': round(time.time() - t_start, 3)})
-                        + '\n').encode()
+                    ok, checks = healthz_report()
+                    doc = {'status': 'ok' if ok else 'degraded',
+                           'uptime_s': round(time.time() - t_start, 3)}
+                    if checks:
+                        doc['checks'] = checks
+                    body = (json.dumps(doc) + '\n').encode()
                     ctype = 'application/json'
-                    code = 200
+                    code = 200 if ok else 503
                 else:
                     body = b'paddle_tpu: /metrics and /healthz\n'
                     ctype = 'text/plain'

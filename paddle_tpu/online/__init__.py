@@ -1,0 +1,35 @@
+"""Continuous learning: one loop from live traffic to hot-swapped
+servable (ROADMAP item 4).
+
+The subsystem joins three layers that already existed but had never
+been connected, and is the first place training and serving run
+concurrently in ONE process — the scenario the serving fleet was built
+for:
+
+- :mod:`stream` — a tailing clickstream reader (simulated Criteo-style
+  CTR rows, frequency-skewed ids, a concept-drift knob) with resumable
+  byte offsets: the (checkpoint, offset) pair is a complete restart
+  token, so a bounced trainer replays nothing and skips nothing.
+- :mod:`trainer` — :class:`OnlineTrainer`: periodic fine-tune rounds
+  via ``Executor.run_steps`` off the tail, checkpointed through the
+  io.py manifest/STEP protocol each round, with per-round fresh
+  holdout rows reserved for the gate.
+- :mod:`controller` — :class:`OnlineController`: the eval gate
+  (shared :class:`~paddle_tpu.evaluator.StreamingAUC`, absolute floor
+  + delta-vs-serving), promote to numbered ``export_bucketed``
+  versions + ``ServingFleet.deploy()`` (HBM-budget precheck included),
+  automatic ``rollback()`` on live-AUC / p99 regression, and a
+  first-class freshness SLO (``paddle_tpu_online_model_age_seconds``
+  gauge, counted violations, /healthz degradation).
+
+Opt-in and additive: nothing here is imported by ``paddle_tpu``'s
+top-level ``__init__``; training-only and serving-only deployments pay
+nothing for it.
+"""
+from .stream import (ClickstreamTail, ClickstreamWriter, format_row,
+                     parse_row)
+from .trainer import OnlineTrainer
+from .controller import OnlineController
+
+__all__ = ['ClickstreamTail', 'ClickstreamWriter', 'OnlineTrainer',
+           'OnlineController', 'format_row', 'parse_row']

@@ -1,0 +1,358 @@
+"""Clickstream log: append-only request/label rows + a tailing reader
+with resumable byte offsets.
+
+The online-training loop's data source is the serving path's exhaust: a
+log of (features, label) rows appended as feedback arrives (the Monolith
+/ TFX pattern — training data IS recent traffic).  This module gives
+that loop a concrete, simulated substrate:
+
+- :class:`ClickstreamWriter` appends Criteo-style CTR rows — a dense
+  float vector plus frequency-skewed sparse ids (a few head ids absorb
+  most traffic, the long tail is cold, like real hashed-id slots) —
+  whose label is a noisy logistic function of planted feature
+  interactions, with a **drift knob**: ``drift`` in [0, 1] rotates the
+  feature->label coupling toward a second fixed coupling, so a mid-run
+  ``drift`` change makes the model the fleet is serving measurably
+  stale (the scenario the eval gate + freshness SLO exist for).
+
+- :class:`ClickstreamTail` tails the log from a **byte offset**.  Only
+  complete ``\\n``-terminated lines are consumed — a torn tail write
+  (the writer mid-append) is left for the next poll, never half-parsed.
+  ``offset`` always equals "every byte of every row this reader has
+  delivered, and nothing more", so persisting it next to the training
+  checkpoint (``OnlineTrainer`` commits it through the io.py ``.prev``
+  record protocol) makes a restarted trainer resume exactly: no row
+  replayed, no row skipped.  :meth:`seek` rewinds — the trainer uses it
+  to put back a partially-collected batch, and tests use it to prove
+  resume exactness.
+
+The line format is deliberately boring text (one row per line:
+``label<TAB>dense csv<TAB>sparse-id csv``): self-delimiting, so byte
+offsets are row boundaries; appendable from any process; greppable when
+an incident needs eyeballs on the data.
+
+:meth:`ClickstreamTail.reader` adapts the tail to the standard reader
+protocol (a creator returning a generator), so the existing
+``paddle_tpu.reader`` decorators compose: ``metered(tail.reader())``
+counts samples, ``buffered(...)`` prefetches.  Note that a prefetching
+decorator pulls AHEAD of consumption by design — when exact offset
+commits matter (the trainer), pull from the tail directly and commit at
+quiescent round boundaries, which is what ``OnlineTrainer`` does.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..flags import FLAGS
+
+__all__ = ['ClickstreamWriter', 'ClickstreamTail', 'format_row',
+           'parse_row']
+
+
+def format_row(dense, ids, label):
+    """One row as its log line (no trailing newline): ``label<TAB>
+    dense csv<TAB>sparse-id csv``."""
+    return '%d\t%s\t%s' % (
+        int(label),
+        ','.join('%.6g' % float(d) for d in dense),
+        ','.join(str(int(i)) for i in ids))
+
+
+def parse_row(line):
+    """Inverse of :func:`format_row`: ``(dense float32[D], ids
+    int64[S], label int)``."""
+    label, dense, ids = line.split('\t')
+    return (np.array([float(x) for x in dense.split(',')],
+                     dtype=np.float32),
+            np.array([int(x) for x in ids.split(',')], dtype=np.int64),
+            int(label))
+
+
+class ClickstreamWriter(object):
+    """Append Criteo-style CTR rows to a log file.
+
+    Synthetic but structured: each row is ``n_dense`` standard-normal
+    dense features plus ``n_slots`` sparse ids drawn frequency-skewed
+    from ``[0, id_space)`` (``u^skew * id_space`` — a handful of head
+    ids dominate, the Criteo shape the hot-row caches in ROADMAP item 2
+    care about).  The label is ``score + noise > 0`` where ``score``
+    couples the dense vector and a per-id effect through TWO fixed
+    random couplings, blended by ``drift``: at ``drift=0`` coupling A
+    alone decides, at ``drift=1`` coupling B does — so sliding drift
+    mid-run changes WHICH patterns predict the label while the marginal
+    feature and label distributions stay put (covariate-shift-free
+    concept drift, the nastiest kind for a stale model).
+
+    ``flip_labels=True`` on :meth:`append` writes rows with inverted
+    labels — the "corrupted upstream joiner" fault the benchmark
+    injects to prove the auto-rollback path.
+    """
+
+    def __init__(self, path, n_dense=13, n_slots=8, id_space=10000,
+                 seed=0, skew=3.0):
+        self.path = path
+        self.n_dense = int(n_dense)
+        self.n_slots = int(n_slots)
+        self.id_space = int(id_space)
+        self.skew = float(skew)
+        self._rng = np.random.default_rng(seed)
+        cpl = np.random.default_rng(seed + 1)
+        # two fixed couplings; drift blends A -> B
+        self._w_a = cpl.normal(size=self.n_dense)
+        self._w_b = cpl.normal(size=self.n_dense)
+        # per-slot id effect: a cheap deterministic hash of the id,
+        # sign-flipped between the two regimes so drift actually
+        # inverts what the head ids mean
+        self._id_mod = 17 + 2 * np.arange(self.n_slots)
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        if not os.path.exists(path):
+            with open(path, 'a'):
+                pass
+
+    def make_row(self, drift=0.0):
+        """One (dense, ids, label) sample at the given drift.
+        Thread-safe: the shared Generator is advanced under the writer
+        lock (a benchmark's traffic thread draws rows while the log
+        feeder appends — numpy Generators are not thread-safe)."""
+        with self._lock:
+            return self._make_row_locked(float(drift))
+
+    def _make_row_locked(self, drift):
+        rng = self._rng
+        dense = rng.normal(size=self.n_dense).astype(np.float32)
+        u = rng.random(size=self.n_slots)
+        ids = np.minimum((u ** self.skew * self.id_space).astype(np.int64),
+                         self.id_space - 1)
+        w = (1.0 - drift) * self._w_a + drift * self._w_b
+        id_fx = ((ids % self._id_mod) - self._id_mod / 2.0) \
+            / self._id_mod
+        score = float(dense @ w) \
+            + (1.0 - 2.0 * drift) * 2.0 * float(id_fx.sum())
+        label = int(score + rng.normal() > 0)
+        return dense, ids, label
+
+    def append(self, rows, drift=0.0, flip_labels=False):
+        """Append ``rows`` fresh samples; returns the file size (bytes)
+        after the write.  The whole batch is written with one
+        ``write`` + flush, so a tailing reader sees at most one torn
+        line at the end — which it will not consume until the next
+        append completes it."""
+        lines = []
+        # draw under the RNG lock only — holding it across the fsync
+        # would stall concurrent make_row callers (a traffic thread)
+        # on disk-sync latency.  The write itself needs no lock: one
+        # write(2) to an O_APPEND stream is atomic, and row order
+        # across concurrent appenders is not meaningful
+        with self._lock:
+            for _ in range(int(rows)):
+                dense, ids, label = self._make_row_locked(float(drift))
+                if flip_labels:
+                    label = 1 - label
+                lines.append(format_row(dense, ids, label))
+        data = ''.join(l + '\n' for l in lines)
+        with open(self.path, 'a') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        return os.path.getsize(self.path)
+
+
+class ClickstreamTail(object):
+    """Tail a clickstream log from a byte offset, complete lines only.
+
+    ``offset`` is the byte position of the first UNread row: it
+    advances exactly over the rows :meth:`read_rows` returns, so the
+    pair (log file, offset) is a complete resume token.  The trainer
+    persists it through ``io.write_rollback_json`` next to its
+    checkpoint manifest; a fresh process constructs
+    ``ClickstreamTail(path, offset=saved)`` and the stream continues as
+    if the restart never happened.
+    """
+
+    def __init__(self, path, offset=0, poll_ms=None):
+        self.path = path
+        self.offset = int(offset)
+        self._poll_s = (FLAGS.online_poll_ms if poll_ms is None
+                        else float(poll_ms)) / 1e3
+
+    def seek(self, offset):
+        """Reposition the tail (rewind a put-back, or resume)."""
+        self.offset = int(offset)
+
+    def size(self):
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def available_bytes(self):
+        return max(0, self.size() - self.offset)
+
+    def skip_to_latest(self, keep_bytes=0):
+        """Freshness-first catch-up: advance the offset toward the log
+        tail, leaving at most ``keep_bytes`` of backlog, landing on a
+        row boundary.  Returns the bytes skipped.
+
+        An online trainer that fell behind (slow round, upstream
+        burst) has a choice: grind through stale backlog in order, or
+        jump to the freshest window and deliberately skip the middle.
+        For a freshness-SLO-driven loop the latter is usually right —
+        the skipped rows are accounted exactly like gate-rejected
+        ones (offset moves past them, they are never replayed)."""
+        size = self.size()
+        target = size - max(0, int(keep_bytes))
+        if target <= self.offset:
+            return 0
+        try:
+            f = open(self.path, 'rb')
+        except OSError:
+            return 0
+        with f:
+            if target <= 0:
+                pos = 0
+            else:
+                f.seek(target)
+                line = f.readline()
+                if line.endswith(b'\n'):
+                    pos = f.tell()  # mid-row landing: next boundary
+                else:
+                    # landed inside the torn tail (a writer
+                    # mid-append): back up to the last complete row
+                    # boundary at or before the target instead
+                    back = min(target, 1 << 20)
+                    f.seek(target - back)
+                    buf = f.read(back)
+                    nl = buf.rfind(b'\n')
+                    if nl < 0:
+                        return 0  # no boundary in reach: stay put
+                    pos = target - back + nl + 1
+        skipped = pos - self.offset
+        if skipped <= 0:
+            return 0
+        self.offset = pos
+        return skipped
+
+    def read_rows(self, max_rows):
+        """Up to ``max_rows`` parsed rows from the current offset,
+        without blocking.  Consumes (and accounts into ``offset``) only
+        the returned rows' bytes: fewer complete lines than asked means
+        a shorter list and the partial tail stays unread.  A malformed
+        line raises with its byte position and leaves ``offset``
+        UNTOUCHED — rows parsed earlier in the same call are not
+        delivered, so they must not be consumed either (the log is the
+        training system's input of record; an offset that ran ahead of
+        a discarded batch would silently skip rows forever)."""
+        max_rows = int(max_rows)
+        if max_rows <= 0:
+            return []
+        rows = []
+        try:
+            f = open(self.path, 'rb')
+        except OSError:
+            return rows
+        with f:
+            f.seek(self.offset)
+            off = self.offset
+            while len(rows) < max_rows:
+                line = f.readline()
+                if not line or not line.endswith(b'\n'):
+                    break  # EOF or torn tail write: leave it unread
+                try:
+                    rows.append(parse_row(line[:-1].decode('utf-8')))
+                except (ValueError, UnicodeDecodeError) as e:
+                    raise ValueError(
+                        "malformed clickstream row at byte %d of %s: "
+                        "%s" % (off, self.path, e))
+                off += len(line)
+            self.offset = off
+        return rows
+
+    def wait_rows(self, n, timeout_s=None, stop=None):
+        """Block (polling every ``online_poll_ms``) until ``n`` rows
+        are read, the ``timeout_s`` budget is spent, or ``stop`` (a
+        ``threading.Event``) is set; returns what was read — possibly
+        fewer than ``n``.  The offset accounts exactly the returned
+        rows, as in :meth:`read_rows`."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + float(timeout_s)
+        start = self.offset
+        rows = []
+        try:
+            while len(rows) < n:
+                rows.extend(self.read_rows(n - len(rows)))
+                if len(rows) >= n:
+                    break
+                if stop is not None and stop.is_set():
+                    break
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    break
+                time.sleep(self._poll_s)
+        except BaseException:
+            # a raising call delivers nothing, so it must consume
+            # nothing — including rows read by earlier iterations
+            self.offset = start
+            raise
+        return rows
+
+    def reader(self, follow=False, stop=None):
+        """Standard reader creator over the tail, composing with the
+        ``paddle_tpu.reader`` decorators: returns a function whose
+        calls yield parsed rows from the CURRENT offset.  With
+        ``follow=False`` (default) iteration ends at the log's current
+        end; ``follow=True`` keeps polling for appended rows until
+        ``stop`` (a ``threading.Event``) is set.  The offset advances
+        per delivered row, so breaking out of the loop mid-stream
+        leaves it exactly at the first unconsumed row."""
+
+        def _gen():
+            # one persistent handle, one row per pull: the offset must
+            # never run ahead of what the consumer actually received
+            # (a batched read here would orphan rows if the consumer
+            # broke out), but per-row open()/seek()/close() would cost
+            # ~4 syscalls per training sample — so the handle stays
+            # open and only re-seeks when someone moved self.offset
+            # externally (seek / skip_to_latest / another reader)
+            f, fpos = None, None
+            try:
+                while True:
+                    if f is None or fpos != self.offset:
+                        if f is not None:
+                            f.close()
+                        try:
+                            f = open(self.path, 'rb')
+                        except OSError:
+                            f = None
+                        else:
+                            f.seek(self.offset)
+                            fpos = self.offset
+                    line = f.readline() if f is not None else b''
+                    if line.endswith(b'\n'):
+                        try:
+                            row = parse_row(
+                                line[:-1].decode('utf-8'))
+                        except (ValueError, UnicodeDecodeError) as e:
+                            raise ValueError(
+                                "malformed clickstream row at byte "
+                                "%d of %s: %s"
+                                % (self.offset, self.path, e))
+                        fpos += len(line)
+                        self.offset = fpos
+                        yield row
+                        continue
+                    if line and f is not None:
+                        f.seek(fpos)  # torn tail: unread the partial
+                    if not follow:
+                        return
+                    if stop is not None and stop.is_set():
+                        return
+                    time.sleep(self._poll_s)
+            finally:
+                if f is not None:
+                    f.close()
+
+        return _gen

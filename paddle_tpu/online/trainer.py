@@ -1,0 +1,323 @@
+"""OnlineTrainer: periodic fine-tune rounds off a clickstream tail.
+
+One round = pull a window of fresh rows from the stream, run them as
+ONE device-resident ``Executor.run_steps`` scan (the PR-6 resumable
+boundary), checkpoint through the io.py manifest/STEP protocol, and
+commit the stream offset — after which the round is durable: a process
+restart resumes from (checkpoint, offset) replaying nothing and
+skipping nothing.
+
+**Round triggers.**  Row-count (``steps_per_round`` full batches,
+default derived from ``PADDLE_TPU_ONLINE_ROUND_ROWS``) or window
+(``PADDLE_TPU_ONLINE_ROUND_WINDOW_S``: after that many seconds of
+collecting, train on whatever full batches arrived).  Rows are only
+ever consumed in whole batches — a partially collected batch is
+``seek``-ed back into the stream, so the offset never covers a row no
+step trained on.
+
+**Holdout.**  The LAST ``holdout_batches`` batches of each round are
+withheld from training and returned raw in the round report: fresh,
+genuinely held-out labeled rows for the controller's eval gate
+(progressive validation — the gate never scores the candidate on rows
+it just fit).  Their bytes ARE committed (they were consumed, for
+evaluation); they are never replayed.
+
+**Commit protocol** (crash-exact, in this order):
+
+1. ``STREAM_OFFSET.json`` — ``{offset, step}`` via
+   ``io.write_rollback_json`` (the ``.prev`` archive protocol), bound
+   to the step the checkpoint is ABOUT to record;
+2. ``io.save_checkpoint(step=...)`` — params + optimizer state, the
+   manifest/STEP torn-window-safe pair.
+
+A crash between 1 and 2 leaves an offset record one round AHEAD of the
+checkpoint; resume detects the step mismatch and falls back to the
+``.prev`` offset record, which matches — either way the restarted
+trainer's (weights, next row) pair is one the crashed process actually
+had.  :meth:`rollback_round` (the gate's reject path) restores the
+previous checkpoint via ``io.rollback_checkpoint`` and RE-BINDS the
+offset record to the restored step at the CURRENT offset: the rejected
+round's rows are deliberately skipped, not replayed — data bad enough
+to fail the gate must not be fed back in a loop.
+"""
+import logging
+import os
+import time
+
+import numpy as np
+
+from .. import io as _io
+from .. import observability as _obs
+from ..flags import FLAGS
+
+_log = logging.getLogger(__name__)
+
+__all__ = ['OnlineTrainer']
+
+OFFSET_RECORD = 'STREAM_OFFSET.json'
+
+
+class _TrainerMetrics(object):
+    """Registry handles labeled by pipeline id; private registry when
+    observability is disabled (reports keep working, nothing exports)."""
+
+    def __init__(self, pid):
+        reg = _obs.registry() if _obs.enabled() \
+            else _obs.MetricsRegistry()
+        L = ('pipeline',)
+        self._families = []
+        self._pid = pid
+
+        def child(metric):
+            self._families.append(metric)
+            return metric.labels(pipeline=pid)
+
+        self.rows = child(reg.counter(
+            'paddle_tpu_online_rows_trained_total',
+            'clickstream rows consumed into fine-tune steps', L))
+        self.steps = child(reg.counter(
+            'paddle_tpu_online_steps_total',
+            'fine-tune steps executed by the online trainer', L))
+        self.round_seconds = child(reg.histogram(
+            'paddle_tpu_online_round_seconds',
+            'wall time of one fine-tune round (collect + train + '
+            'checkpoint + offset commit)', L,
+            buckets=_obs.DEFAULT_COMPILE_BUCKETS))
+
+    def close(self):
+        for m in self._families:
+            m.remove(pipeline=self._pid)
+
+
+class OnlineTrainer(object):
+    """Fine-tune a training program from a :class:`~paddle_tpu.online
+    .stream.ClickstreamTail`, one checkpointed round at a time.
+
+    :param executor: the ``Executor`` running the rounds.
+    :param program: the TRAIN program (loss + optimizer ops appended).
+    :param stream: a ``ClickstreamTail`` positioned anywhere; resume
+        repositions it from the committed offset record.
+    :param batch_fn: ``batch_fn(rows) -> feed dict`` turning
+        ``batch_size`` parsed rows into one step's feed.
+    :param batch_size: rows per step.
+    :param checkpoint_dir: where the manifest/STEP/offset records
+        live.  If it already holds a checkpoint, the trainer RESUMES:
+        weights + step from ``io.load_checkpoint``, stream offset from
+        the matching offset record.  A fresh dir gets a step-0
+        checkpoint immediately, so even the first round has a rollback
+        target.
+    :param steps_per_round: train batches per round (default
+        ``PADDLE_TPU_ONLINE_ROUND_ROWS // batch_size``, min 1).
+    :param holdout_batches: batches per round withheld from training
+        and returned as ``report['holdout_rows']`` for the eval gate.
+    :param round_window_s: time trigger (default
+        ``PADDLE_TPU_ONLINE_ROUND_WINDOW_S``; 0 = row-count only).
+    :param fetch_list: per-step fetches (e.g. the loss variable);
+        round reports carry their per-round means.
+    :param scope: the training Scope (default global scope).
+    """
+
+    _seq = iter(range(1 << 30))
+
+    def __init__(self, executor, program, stream, batch_fn, batch_size,
+                 checkpoint_dir, steps_per_round=None,
+                 holdout_batches=1, round_window_s=None,
+                 fetch_list=None, scope=None, pipeline_id=None):
+        from ..core.scope import global_scope
+        self._exe = executor
+        self._program = program
+        self._stream = stream
+        self._batch_fn = batch_fn
+        self.batch_size = int(batch_size)
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if steps_per_round is None:
+            steps_per_round = max(
+                1, int(FLAGS.online_round_rows) // self.batch_size)
+        self.steps_per_round = int(steps_per_round)
+        self.holdout_batches = int(holdout_batches)
+        if self.holdout_batches < 0:
+            raise ValueError("holdout_batches must be >= 0")
+        self._window_s = (float(FLAGS.online_round_window_s)
+                          if round_window_s is None
+                          else float(round_window_s))
+        self._fetch_list = list(fetch_list or [])
+        self._scope = scope if scope is not None else global_scope()
+        self._ckpt_dir = checkpoint_dir
+        self._offset_path = os.path.join(checkpoint_dir, OFFSET_RECORD)
+        self._poll_s = float(FLAGS.online_poll_ms) / 1e3
+        self.pid = pipeline_id or ('ol%d' % next(OnlineTrainer._seq))
+        self._m = _TrainerMetrics(self.pid)
+        self.step = 0
+        self.rounds = 0
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        if _io._read_manifest(checkpoint_dir):
+            self._resume()
+        else:
+            # a first checkpoint at step 0: round 1 then SUPERSEDES a
+            # checkpoint, so its .prev archive exists and a gate reject
+            # of the very first round still has a rollback target
+            _io.write_rollback_json(
+                self._offset_path,
+                {'offset': self._stream.offset, 'step': 0})
+            _io.save_checkpoint(self._exe, checkpoint_dir,
+                                self._program, step=0,
+                                scope=self._scope)
+
+    # -- resume --------------------------------------------------------
+    def _resume(self):
+        step = _io.load_checkpoint(self._exe, self._ckpt_dir,
+                                   self._program, scope=self._scope)
+        self.step = int(step or 0)
+        rec = _io.read_rollback_json(self._offset_path)
+        prev = _io.read_rollback_json(self._offset_path, prev=True)
+        if rec is not None and int(rec.get('step', -1)) == self.step:
+            self._stream.seek(rec['offset'])
+        elif prev is not None and int(prev.get('step', -1)) == self.step:
+            # crash landed between the offset commit and the checkpoint
+            # write: the live record belongs to the round the crash
+            # discarded — the .prev archive matches this checkpoint
+            self._stream.seek(prev['offset'])
+        elif rec is not None:
+            _log.warning(
+                "online trainer %s: offset record step %s does not "
+                "match checkpoint step %d — resuming from the recorded "
+                "offset (skipping is safe; replaying would double-"
+                "train)", self.pid, rec.get('step'), self.step)
+            self._stream.seek(rec['offset'])
+        # no record at all: the stream stays where the caller put it
+
+    # -- rounds --------------------------------------------------------
+    def collect_round(self, max_wait_s=None, stop=None):
+        """Pull this round's rows: up to ``steps_per_round +
+        holdout_batches`` whole batches.  Returns a list of row-lists
+        (one per batch).  Blocks polling until the full round is
+        collected, the round window elapses (with >= 1 batch), the
+        ``max_wait_s`` budget is spent, or ``stop`` is set.  A partial
+        batch is always seeked back — consumed rows are exactly
+        ``len(result) * batch_size``."""
+        want = self.steps_per_round + self.holdout_batches
+        batches = []
+        # the partial batch accumulates IN MEMORY across polls (rows
+        # are parsed once, not re-read from disk every poll); only a
+        # round that ends with it incomplete seeks its bytes back
+        pending, pend_off = [], self._stream.offset
+        t0 = time.monotonic()
+        while len(batches) < want:
+            if not pending:
+                pend_off = self._stream.offset
+            try:
+                pending.extend(self._stream.read_rows(
+                    self.batch_size - len(pending)))
+            except BaseException:
+                # a parse failure mid-collection: the pending rows'
+                # bytes are consumed but will never be delivered —
+                # put them back before propagating, keeping this
+                # method's own consumed==delivered promise
+                if pending:
+                    self._stream.seek(pend_off)
+                raise
+            if len(pending) == self.batch_size:
+                batches.append(pending)
+                pending = []
+                continue
+            now = time.monotonic()
+            if stop is not None and stop.is_set():
+                break
+            if self._window_s > 0 and batches \
+                    and now - t0 >= self._window_s:
+                break
+            if max_wait_s is not None and now - t0 >= float(max_wait_s):
+                break
+            time.sleep(self._poll_s)
+        if pending:
+            self._stream.seek(pend_off)  # put the partial batch back
+        return batches
+
+    def run_round(self, max_wait_s=None, stop=None):
+        """One fine-tune round; returns the round report dict.
+
+        ``outcome`` is ``'trained'`` (steps ran, checkpoint + offset
+        committed) or ``'starved'`` (not even one training batch
+        arrived in the budget — nothing consumed, nothing written).
+        A trained report carries ``steps``, ``rows``, ``step`` (the
+        cumulative step now on disk), ``holdout_rows`` (raw rows of the
+        withheld batches), ``fetch_means`` and ``round_s``.
+
+        A round that RAISES (a malformed log row, a feed/compile
+        failure) consumes nothing: the stream is seeked back to the
+        round's starting offset before the exception propagates, so
+        batches collected earlier in the same round are not silently
+        skipped by a caller that catches and retries."""
+        t0 = time.perf_counter()
+        round_off = self._stream.offset
+        try:
+            batches = self.collect_round(max_wait_s=max_wait_s,
+                                         stop=stop)
+            # the holdout comes off the END (the freshest rows); never
+            # eat every batch — a window-starved round trains on what
+            # it has
+            n_hold = min(self.holdout_batches,
+                         max(len(batches) - 1, 0))
+            train = batches[:len(batches) - n_hold]
+            hold = batches[len(batches) - n_hold:]
+            if not train:
+                return {'outcome': 'starved', 'steps': 0, 'rows': 0,
+                        'step': self.step, 'holdout_rows': [],
+                        'round_s': time.perf_counter() - t0}
+            feeds = [self._batch_fn(rows) for rows in train]
+            fetched = self._exe.run_steps(
+                self._program, feed=feeds,
+                fetch_list=self._fetch_list, scope=self._scope)
+        except BaseException:
+            self._stream.seek(round_off)
+            raise
+        k = len(feeds)
+        self.step += k
+        self.rounds += 1
+        # offset first, then checkpoint — see the module docstring's
+        # crash-ordering argument
+        _io.write_rollback_json(
+            self._offset_path,
+            {'offset': self._stream.offset, 'step': self.step})
+        _io.save_checkpoint(self._exe, self._ckpt_dir, self._program,
+                            step=self.step, scope=self._scope)
+        wall = time.perf_counter() - t0
+        self._m.rows.inc(k * self.batch_size)
+        self._m.steps.inc(k)
+        self._m.round_seconds.observe(wall)
+        fetch_means = {}
+        for i, f in enumerate(self._fetch_list):
+            name = getattr(f, 'name', str(f))
+            fetch_means[name] = float(np.mean(
+                np.asarray(fetched[i], dtype=np.float64)))
+        return {'outcome': 'trained', 'steps': k,
+                'rows': k * self.batch_size, 'step': self.step,
+                'holdout_rows': [r for b in hold for r in b],
+                'fetch_means': fetch_means, 'round_s': wall}
+
+    def rollback_round(self):
+        """Reject the last round: restore the previous (params, step)
+        checkpoint pair into the scope and re-bind the offset record to
+        the restored step at the CURRENT stream position — the rejected
+        rows are skipped forward, not queued for replay.  Returns the
+        restored step."""
+        step = _io.rollback_checkpoint(self._ckpt_dir)
+        _io.load_checkpoint(self._exe, self._ckpt_dir, self._program,
+                            scope=self._scope)
+        self.step = int(step or 0)
+        _io.write_rollback_json(
+            self._offset_path,
+            {'offset': self._stream.offset, 'step': self.step})
+        return self.step
+
+    @property
+    def checkpoint_dir(self):
+        return self._ckpt_dir
+
+    @property
+    def scope(self):
+        return self._scope
+
+    def close(self):
+        self._m.close()

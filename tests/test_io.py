@@ -760,3 +760,65 @@ def test_rollback_to_stepless_checkpoint_clears_step(tmp_path):
     assert not os.path.exists(os.path.join(ckpt, 'STEP')), \
         "STEP=7 survived a rollback to a step-less checkpoint"
     assert io.load_checkpoint(exe, ckpt, main) is None
+
+
+# -- version-dir retention (gc_versions) --------------------------------
+def _mk_version(base, name, with_artifacts=True):
+    d = os.path.join(str(base), name)
+    os.makedirs(d, exist_ok=True)
+    if with_artifacts:
+        with open(os.path.join(d, 'bucket_1.stablehlo'), 'wb') as f:
+            f.write(b'artifact')
+    return d
+
+
+def test_gc_versions_retention_and_protection(tmp_path):
+    base = str(tmp_path / 'versions')
+    for v in range(1, 7):
+        _mk_version(base, str(v))
+    _mk_version(base, 'canary')              # non-numeric: never GC'd
+    _mk_version(base, '0', with_artifacts=False)  # mid-export: invisible
+
+    removed = io.gc_versions(base, keep=3, protect=['2'])
+    assert removed == ['1', '3']
+    left = sorted(os.listdir(base))
+    assert left == ['0', '2', '4', '5', '6', 'canary']
+    # idempotent second pass removes nothing new
+    assert io.gc_versions(base, keep=3, protect=['2']) == []
+    # protection by PATH works like protection by name
+    assert io.gc_versions(
+        base, keep=1, protect=[os.path.join(base, '4'),
+                               os.path.join(base, '5'), '2']) == []
+
+
+def test_gc_versions_always_keeps_the_highest(tmp_path):
+    """keep is floored at 1: the numerically-highest version is what a
+    concurrent deploy(base) resolves, so it must survive even keep=0 —
+    and resolve_version_dir still works after any GC."""
+    base = str(tmp_path / 'versions')
+    for v in ('1', '2', '3'):
+        _mk_version(base, v)
+    removed = io.gc_versions(base, keep=0)
+    assert removed == ['1', '2']
+    d, name = io.resolve_version_dir(base)
+    assert name == '3' and io.bucket_artifacts(d)
+    assert io.gc_versions(base, keep=0) == []  # nothing left to prune
+
+
+def test_gc_versions_missing_base_is_empty(tmp_path):
+    assert io.gc_versions(str(tmp_path / 'nope'), keep=2) == []
+
+
+def test_gc_versions_sweeps_orphan_tombstones(tmp_path):
+    """A GC that crashed between its rename and rmtree leaves a
+    non-numeric '<v>.gc.<pid>' tombstone; later passes must finish the
+    deletion instead of leaking one artifact set per crash forever."""
+    base = str(tmp_path / 'versions')
+    for v in ('1', '2', '3'):
+        _mk_version(base, v)
+    orphan = _mk_version(base, '9.gc.12345')  # the stranded victim
+    assert os.path.isdir(orphan)
+    removed = io.gc_versions(base, keep=3)
+    assert removed == []                      # nothing newly pruned
+    assert not os.path.exists(orphan), "tombstone not swept"
+    assert sorted(os.listdir(base)) == ['1', '2', '3']

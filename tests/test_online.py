@@ -1,0 +1,887 @@
+"""Online continuous-learning pipeline: stream -> fine-tune -> eval
+gate -> hot-swap (paddle_tpu/online/).
+
+Covers: streaming-AUC goldens vs the batch auc op and the exact
+pairwise statistic, clickstream tail resume-from-offset exactness
+(incl. torn tail writes and the crash window between offset commit and
+checkpoint), reader-decorator composition, gate pass/fail/promote with
+checkpoint rollback, injected-bad-round automatic fleet rollback
+(reason-counted), freshness-SLO violation counting + /healthz
+degradation, version-dir GC under a live fleet (the deploy->promote->gc
+race), and trainer + fleet running concurrently in one process with
+zero dropped requests.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io, observability
+from paddle_tpu.core.program import reset_unique_name_guard
+from paddle_tpu.evaluator import StreamingAUC
+from paddle_tpu.inference import ServingFleet, export_bucketed
+from paddle_tpu.online import (ClickstreamTail, ClickstreamWriter,
+                               OnlineController, OnlineTrainer)
+
+N_DENSE, N_SLOTS, ID_SPACE, B = 6, 2, 200, 8
+
+
+# -- StreamingAUC goldens ----------------------------------------------
+def _exact_auc(scores, labels):
+    """Exact pairwise (Mann-Whitney) AUC with the 1/2-tie convention —
+    the definition StreamingAUC quantizes."""
+    s = np.asarray(scores, dtype=np.float64)
+    y = np.asarray(labels)
+    pos, neg = s[y == 1], s[y == 0]
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    return (wins + 0.5 * ties) / (len(pos) * len(neg))
+
+
+def test_streaming_auc_equals_exact_auc_on_quantized_scores():
+    rng = np.random.default_rng(0)
+    bins = 512
+    s = rng.random(4000)
+    y = (rng.random(4000) < 0.25 + 0.5 * s).astype(np.int64)
+    a = StreamingAUC(bins=bins).update(s, y)
+    # quantize to bin centers: the histogram AUC is EXACTLY the
+    # pairwise AUC of the quantized scores
+    q = (np.clip((s * bins).astype(np.int64), 0, bins - 1) + 0.5) / bins
+    assert a.eval() == pytest.approx(_exact_auc(q, y), abs=1e-12)
+    # and within bin-width slop of the unquantized statistic
+    assert a.eval() == pytest.approx(_exact_auc(s, y), abs=2.0 / bins)
+
+
+def test_streaming_auc_update_merge_order_invariance():
+    rng = np.random.default_rng(1)
+    s = rng.random(3000)
+    y = (rng.random(3000) < s).astype(np.int64)
+    one = StreamingAUC(bins=256).update(s, y)
+    chunked = StreamingAUC(bins=256)
+    for i in range(0, 3000, 171):
+        chunked.update(s[i:i + 171], y[i:i + 171])
+    parts = [StreamingAUC(bins=256).update(s[i::3], y[i::3])
+             for i in range(3)]
+    merged = parts[0].merge(parts[1]).merge(parts[2])
+    assert one.eval() == chunked.eval() == merged.eval()
+    assert one.count == merged.count == 3000
+    with pytest.raises(ValueError):
+        one.merge(StreamingAUC(bins=128))
+
+
+def test_streaming_auc_matches_batch_auc_op():
+    """Golden vs the in-graph batch AUC (the layers.auc op, 200
+    thresholds): one metric definition across gate, live monitor, and
+    training graphs."""
+    rng = np.random.default_rng(2)
+    n = 2000
+    s = rng.random(n).astype(np.float32)
+    y = (rng.random(n) < 0.2 + 0.6 * s).astype(np.int64)
+    with reset_unique_name_guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            probs = fluid.layers.data(name='probs', shape=[2],
+                                      dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            auc_var = fluid.layers.auc(input=probs, label=label)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    two_col = np.stack([1.0 - s, s], axis=1)
+    got = exe.run(main, feed={'probs': two_col,
+                              'label': y.reshape(-1, 1)},
+                  fetch_list=[auc_var], scope=scope)[0]
+    stream = StreamingAUC(bins=200).update(s, y).eval()
+    assert float(np.ravel(got)[0]) == pytest.approx(stream, abs=0.01)
+    assert stream == pytest.approx(_exact_auc(s, y), abs=0.01)
+
+
+def test_streaming_auc_degenerate_and_reset():
+    a = StreamingAUC(bins=64)
+    assert a.eval() == 0.5  # empty: neutral
+    a.update([0.9, 0.8], [1, 1])
+    assert a.eval() == 0.5  # one class only
+    a.update([0.1], [0])
+    assert a.eval() == 1.0  # perfectly separated
+    assert (a.positives, a.negatives) == (2, 1)
+    a.reset()
+    assert a.count == 0 and a.eval() == 0.5
+
+
+# -- clickstream tail ---------------------------------------------------
+def _mk_log(tmp_path, rows=64, **kw):
+    log = str(tmp_path / 'click.log')
+    kw.setdefault('n_dense', N_DENSE)
+    kw.setdefault('n_slots', N_SLOTS)
+    kw.setdefault('id_space', ID_SPACE)
+    w = ClickstreamWriter(log, seed=3, **kw)
+    if rows:
+        w.append(rows)
+    return log, w
+
+
+def _rows_equal(a, b):
+    return ((a[0] == b[0]).all() and (a[1] == b[1]).all()
+            and a[2] == b[2])
+
+
+def test_tail_resume_from_offset_is_exact(tmp_path):
+    """A reader resumed from a persisted offset sees exactly the rows
+    the first reader did not consume — no replay, no skip."""
+    log, w = _mk_log(tmp_path, rows=50)
+    t1 = ClickstreamTail(log)
+    first = t1.read_rows(20)
+    assert len(first) == 20
+    saved = t1.offset
+    rest1 = t1.read_rows(1000)
+    # a fresh process: new tail at the persisted offset
+    t2 = ClickstreamTail(log, offset=saved)
+    rest2 = t2.read_rows(1000)
+    assert len(rest1) == len(rest2) == 30
+    assert all(_rows_equal(x, z) for x, z in zip(rest1, rest2))
+    # appended rows continue seamlessly from both
+    w.append(5)
+    more = t2.read_rows(100)
+    assert len(more) == 5 and t2.offset == os.path.getsize(log)
+
+
+def test_tail_never_consumes_a_torn_line(tmp_path):
+    log, w = _mk_log(tmp_path, rows=3)
+    size = os.path.getsize(log)
+    with open(log, 'a') as f:
+        f.write('1\t0.5')  # a writer mid-append: no newline yet
+        f.flush()
+    t = ClickstreamTail(log)
+    assert len(t.read_rows(100)) == 3
+    assert t.offset == size  # stopped at the torn tail
+    with open(log, 'a') as f:  # the append completes
+        f.write(',0.1,0.1,0.1,0.1,0.1\t7,9\n')
+    got = t.read_rows(100)
+    assert len(got) == 1 and got[0][2] == 1
+
+
+def test_tail_malformed_row_raises_with_position(tmp_path):
+    log, w = _mk_log(tmp_path, rows=2)
+    with open(log, 'a') as f:
+        f.write('not a row\n')
+    t = ClickstreamTail(log)
+    assert len(t.read_rows(2)) == 2
+    good = t.offset
+    with pytest.raises(ValueError, match='byte %d' % good):
+        t.read_rows(1)
+    # a failing call delivers nothing and consumes nothing — even the
+    # rows parsed BEFORE the bad line in the same call (offset running
+    # ahead of a discarded batch would silently skip them forever)
+    t2 = ClickstreamTail(log)
+    with pytest.raises(ValueError):
+        t2.read_rows(10)
+    assert t2.offset == 0
+    assert len(t2.read_rows(2)) == 2  # still all there
+
+
+def test_tail_skip_to_latest_lands_on_row_boundary(tmp_path):
+    log, w = _mk_log(tmp_path, rows=100)
+    t = ClickstreamTail(log)
+    t.read_rows(10)
+    size = os.path.getsize(log)
+    skipped = t.skip_to_latest(keep_bytes=size // 10)
+    assert skipped > 0
+    rest = t.read_rows(1000)  # parses cleanly: boundary-aligned
+    assert 0 < len(rest) < 90
+    # caught up: nothing to skip, nothing to read
+    assert t.skip_to_latest() == 0 and t.read_rows(10) == []
+    assert t.offset == os.path.getsize(log)
+
+
+def test_tail_reader_composes_with_decorators(tmp_path):
+    """tail.reader() is a standard creator: the reader/ decorators
+    (metered, firstn) stack on it, and the offset tracks exactly the
+    delivered rows even when the consumer stops early."""
+    from paddle_tpu.reader.decorator import firstn, metered
+    log, _w = _mk_log(tmp_path, rows=30)
+    t = ClickstreamTail(log)
+    creator = firstn(metered(t.reader(), name='clickstream'), 12)
+    got = list(creator())
+    assert len(got) == 12
+    # the offset covers exactly the 12 delivered rows: a second tail
+    # from it yields the remaining 18
+    assert len(ClickstreamTail(log, offset=t.offset).read_rows(99)) == 18
+
+
+# -- the training pipeline fixture -------------------------------------
+def _build_model(seed=7):
+    with reset_unique_name_guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            dense = fluid.layers.data(name='dense', shape=[N_DENSE],
+                                      dtype='float32')
+            slots = [fluid.layers.data(name='C%d' % i, shape=[1],
+                                       dtype='int64')
+                     for i in range(N_SLOTS)]
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            embs = [fluid.layers.embedding(input=s, size=[ID_SPACE, 4])
+                    for s in slots]
+            feat = fluid.layers.concat(embs + [dense], axis=1)
+            h = fluid.layers.fc(input=feat, size=16, act='relu')
+            predict = fluid.layers.fc(input=h, size=2, act='softmax')
+            cost = fluid.layers.cross_entropy(input=predict,
+                                              label=label)
+            loss = fluid.layers.mean(x=cost)
+            fluid.optimizer.SGDOptimizer(
+                learning_rate=0.05).minimize(loss)
+        infer = io.get_inference_program([predict], main)
+    return main, startup, infer, predict, loss
+
+
+def _batch_fn(rows):
+    f = {'dense': np.stack([r[0] for r in rows]),
+         'label': np.array([[r[2]] for r in rows], dtype=np.int64)}
+    for i in range(N_SLOTS):
+        f['C%d' % i] = np.array([[r[1][i]] for r in rows],
+                                dtype=np.int64)
+    return f
+
+
+def _request_feed(row):
+    f = {'dense': row[0][None, :]}
+    for i in range(N_SLOTS):
+        f['C%d' % i] = np.array([[row[1][i]]], dtype=np.int64)
+    return f
+
+
+class _Pipeline(object):
+    """Everything one online-loop test needs, built in ~seconds on the
+    CPU smoke config: tiny CTR tower, clickstream, trainer, exported
+    v1, 1-replica fleet, controller."""
+
+    def __init__(self, tmp_path, rows=600, replicas=1, fleet=True,
+                 **ctl_kw):
+        self.main, startup, self.infer, self.predict, self.loss = \
+            _build_model()
+        self.scope = fluid.Scope()
+        self.exe = fluid.Executor(fluid.CPUPlace())
+        self.exe.run(startup, scope=self.scope)
+        self.log, self.writer = _mk_log(tmp_path, rows=rows)
+        self.tail = ClickstreamTail(self.log)
+        self.trainer = OnlineTrainer(
+            self.exe, self.main, self.tail, _batch_fn, batch_size=B,
+            checkpoint_dir=str(tmp_path / 'ckpt'), steps_per_round=3,
+            holdout_batches=1, fetch_list=[self.loss],
+            scope=self.scope)
+        self.specs = {'dense': (N_DENSE,)}
+        self.specs.update({('C%d' % i): (1,)
+                           for i in range(N_SLOTS)})
+        self.export_base = str(tmp_path / 'versions')
+        os.makedirs(self.export_base, exist_ok=True)
+        self.fleet = self.ctl = None
+        if fleet:
+            self.export_fn(os.path.join(self.export_base, '1'))
+            self.fleet = ServingFleet(
+                self.export_base, replicas=replicas, max_wait_ms=10.0,
+                linger_ms=0.3, health_interval_ms=0)
+            ctl_kw.setdefault('auc_floor', 0.0)
+            ctl_kw.setdefault('freshness_slo_s', 0.0)
+            self.ctl = OnlineController(
+                self.trainer, self.fleet, self.export_base,
+                self.export_fn, self.eval_fn,
+                serving_eval_fn=self.serving_eval_fn, **ctl_kw)
+
+    def export_fn(self, vdir):
+        export_bucketed(vdir, self.specs, [self.predict],
+                        executor=self.exe, main_program=self.main,
+                        scope=self.scope, max_batch=2)
+
+    def eval_fn(self, rows):
+        feed = _batch_fn(rows)
+        feed.pop('label')
+        out = self.exe.run(self.infer, feed=feed,
+                           fetch_list=[self.predict],
+                           scope=self.scope)[0]
+        return np.asarray(out)[:, 1], np.array([r[2] for r in rows])
+
+    def serving_eval_fn(self, rows):
+        futs = [self.fleet.submit(_request_feed(r)) for r in rows]
+        scores = [float(np.asarray(f.result(timeout=60.0)[0])[0, 1])
+                  for f in futs]
+        return np.array(scores), np.array([r[2] for r in rows])
+
+    def close(self):
+        if self.ctl is not None:
+            self.ctl.close()
+        else:
+            self.trainer.close()
+        if self.fleet is not None:
+            self.fleet.close()
+
+
+# -- trainer: rounds, offsets, resume ----------------------------------
+def test_trainer_round_and_offset_commit(tmp_path):
+    p = _Pipeline(tmp_path, fleet=False)
+    try:
+        rep = p.trainer.run_round(max_wait_s=2.0)
+        assert rep['outcome'] == 'trained'
+        assert rep['steps'] == 3 and rep['rows'] == 3 * B
+        assert len(rep['holdout_rows']) == B  # 1 withheld batch
+        assert rep['step'] == p.trainer.step == 3
+        assert rep['fetch_means']  # the loss mean came through
+        # offset covers train + holdout rows, committed step-bound
+        rec = io.read_rollback_json(
+            os.path.join(p.trainer.checkpoint_dir,
+                         'STREAM_OFFSET.json'))
+        assert rec == {'offset': p.tail.offset, 'step': 3}
+        # checkpoint landed with the same step
+        assert io._read_step_file(p.trainer.checkpoint_dir) == 3
+    finally:
+        p.close()
+
+
+def test_trainer_resume_replays_nothing_skips_nothing(tmp_path):
+    p = _Pipeline(tmp_path, fleet=False)
+    committed = None
+    try:
+        p.trainer.run_round(max_wait_s=2.0)
+        p.trainer.run_round(max_wait_s=2.0)
+        committed = p.tail.offset
+        step = p.trainer.step
+        w_after = {
+            v.name: np.asarray(p.scope.find_var(v.name)).copy()
+            for v in p.main.global_block().all_parameters()}
+    finally:
+        p.trainer.close()
+    # a NEW process: fresh scope, fresh tail at offset 0 — resume must
+    # restore weights + step and reposition the stream
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    # params come from the checkpoint; startup not needed, but the
+    # scope must exist before load
+    tail2 = ClickstreamTail(p.log)
+    trainer2 = OnlineTrainer(
+        exe2, p.main, tail2, _batch_fn, batch_size=B,
+        checkpoint_dir=p.trainer.checkpoint_dir, steps_per_round=3,
+        holdout_batches=1, scope=scope2)
+    try:
+        assert trainer2.step == step
+        assert tail2.offset == committed  # nothing replayed or skipped
+        for name, want in w_after.items():
+            np.testing.assert_array_equal(
+                np.asarray(scope2.find_var(name)), want, err_msg=name)
+    finally:
+        trainer2.close()
+
+
+def test_trainer_resume_survives_crash_between_offset_and_checkpoint(
+        tmp_path):
+    """The offset record is written BEFORE the checkpoint; a crash in
+    between leaves the live record one round ahead.  Resume detects
+    the step mismatch and uses the .prev record, which matches the
+    checkpoint on disk."""
+    p = _Pipeline(tmp_path, fleet=False)
+    try:
+        p.trainer.run_round(max_wait_s=2.0)
+        good_offset = p.tail.offset
+        step = p.trainer.step
+        # simulate the crashed round's first write: offset advanced,
+        # step+3 claimed, but no checkpoint followed
+        io.write_rollback_json(
+            os.path.join(p.trainer.checkpoint_dir,
+                         'STREAM_OFFSET.json'),
+            {'offset': good_offset + 999, 'step': step + 3})
+    finally:
+        p.trainer.close()
+    tail2 = ClickstreamTail(p.log)
+    trainer2 = OnlineTrainer(
+        fluid.Executor(fluid.CPUPlace()), p.main, tail2, _batch_fn,
+        batch_size=B, checkpoint_dir=p.trainer.checkpoint_dir,
+        steps_per_round=3, scope=fluid.Scope())
+    try:
+        assert trainer2.step == step
+        assert tail2.offset == good_offset  # the .prev record won
+    finally:
+        trainer2.close()
+
+
+def test_trainer_starved_round_consumes_nothing(tmp_path):
+    p = _Pipeline(tmp_path, rows=3, fleet=False)  # < one batch
+    try:
+        off0 = p.tail.offset
+        rep = p.trainer.run_round(max_wait_s=0.2)
+        assert rep['outcome'] == 'starved'
+        assert p.tail.offset == off0  # partial batch seeked back
+        assert p.trainer.step == 0
+    finally:
+        p.close()
+
+
+def test_trainer_failed_round_restores_round_start_offset(tmp_path):
+    """A malformed row mid-round must not orphan the batches collected
+    before it: the raising round seeks the stream back to the round's
+    start, so a catching-and-retrying caller skips nothing."""
+    p = _Pipeline(tmp_path, rows=B, fleet=False)  # exactly one batch
+    try:
+        with open(p.log, 'a') as f:
+            f.write('corrupt line\n')
+        p.writer.append(3 * B)  # plenty of rows behind the corruption
+        off0 = p.tail.offset
+        with pytest.raises(ValueError, match='malformed'):
+            p.trainer.run_round(max_wait_s=2.0)
+        assert p.tail.offset == off0  # the good first batch came back
+        assert p.trainer.step == 0    # and nothing was trained
+    finally:
+        p.close()
+
+
+# -- controller: gate pass/fail/promote, auto-rollback ------------------
+def test_gate_promote_and_gate_fail_rollback(tmp_path):
+    p = _Pipeline(tmp_path)
+    try:
+        assert p.fleet.version == '1'
+        # pass: floor 0 — the round promotes version 2
+        rep = p.ctl.run_round(max_wait_s=5.0)
+        assert rep['outcome'] == 'promoted'
+        assert rep['gate']['passed'] and rep['version'] == '2'
+        assert p.fleet.version == '2'
+        assert p.fleet.stats()['last_deploy_reason'] == 'online_promote'
+        assert p.ctl.promoted_auc == rep['gate']['auc']
+        step_good = p.trainer.step
+        w_good = {
+            v.name: np.asarray(p.scope.find_var(v.name)).copy()
+            for v in p.main.global_block().all_parameters()}
+        # fail: an impossible floor — the round is rejected, the
+        # checkpoint rolls back, nothing deploys, rows are skipped
+        p.ctl.auc_floor = 1.1
+        off_before = p.tail.offset
+        rep = p.ctl.run_round(max_wait_s=5.0)
+        assert rep['outcome'] == 'gate_failed'
+        assert 'auc_floor' in rep['gate']['reasons']
+        assert p.fleet.version == '2'  # no deploy
+        assert p.trainer.step == step_good  # checkpoint rolled back
+        for name, want in w_good.items():
+            np.testing.assert_array_equal(
+                np.asarray(p.scope.find_var(name)), want, err_msg=name)
+        assert p.tail.offset > off_before  # bad rows skipped, not
+        rec = io.read_rollback_json(os.path.join(                 # replayed
+            p.trainer.checkpoint_dir, 'STREAM_OFFSET.json'))
+        assert rec['step'] == step_good
+        assert rec['offset'] == p.tail.offset
+        # outcomes are counted per label
+        text = observability.prometheus_text()
+        pid = p.ctl.pid
+        assert ('paddle_tpu_online_rounds_total{pipeline="%s",'
+                'outcome="promoted"} 1' % pid) in text
+        assert ('paddle_tpu_online_rounds_total{pipeline="%s",'
+                'outcome="gate_failed"} 1' % pid) in text
+    finally:
+        p.close()
+
+
+def test_injected_bad_round_triggers_auto_rollback(tmp_path):
+    """The acceptance drill: a bad round slips past the gate
+    (force_promote — the benchmark's corrupted-upstream injection),
+    live traffic AUC tanks, check() rolls the fleet AND the trainer
+    back, counted under its reason."""
+    p = _Pipeline(tmp_path, live_window=32, live_floor=0.55)
+    try:
+        rep = p.ctl.run_round(max_wait_s=5.0)
+        assert rep['outcome'] == 'promoted' and p.fleet.version == '2'
+        step_good = p.trainer.step
+        # the injected bad round: poisoned rows, gate bypassed
+        p.writer.append(60, flip_labels=True)
+        rep = p.ctl.run_round(max_wait_s=5.0, force_promote=True)
+        assert rep['outcome'] == 'forced' and p.fleet.version == '3'
+        # live outcomes arrive inverted: scores anti-correlate labels
+        s = np.linspace(0.05, 0.95, 32)
+        auc = p.ctl.record_live(s, (s < 0.5).astype(np.int64))
+        assert auc is not None and auc < 0.2
+        reason = p.ctl.check()
+        assert reason == 'live_auc_floor'
+        assert p.fleet.version == '2'  # rolled back
+        assert p.trainer.step == step_good  # trainer rolled back too
+        st = p.fleet.stats()
+        assert st['rollbacks'] == 1
+        assert st['rollbacks_by_reason'] == {'live_auc_floor': 1}
+        assert st['last_deploy_reason'] == 'rollback:live_auc_floor'
+        assert p.ctl.stats()['auto_rollbacks'] == 1
+        assert p.ctl.stats()['last_rollback_reason'] == 'live_auc_floor'
+        # the reason label is on the wire
+        text = observability.prometheus_text()
+        assert ('paddle_tpu_fleet_rollbacks_total{fleet="%s",'
+                'reason="live_auc_floor"} 1' % p.fleet._fid) in text
+        # the live window reset: no repeat rollback on stale data
+        assert p.ctl.check() is None
+    finally:
+        p.close()
+
+
+def test_watchdog_with_no_rollback_target_does_not_crash(tmp_path):
+    """A regression observed before the FIRST promote has nothing to
+    roll back to (the fleet's deploy record has no .prev yet): check()
+    must report no rollback and keep the serving loop alive, not
+    propagate the fleet's RuntimeError."""
+    p = _Pipeline(tmp_path, live_window=16, live_floor=0.55)
+    try:
+        s = np.linspace(0.05, 0.95, 16)
+        p.ctl.record_live(s, (s < 0.5).astype(np.int64))
+        assert p.ctl.check() is None
+        assert p.fleet.version == '1'
+        assert p.fleet.stats()['rollbacks'] == 0
+        # the bad window was discarded: fresh traffic re-judges
+        assert p.ctl.live_auc is None
+    finally:
+        p.close()
+
+
+def test_p99_regression_triggers_auto_rollback(tmp_path):
+    p = _Pipeline(tmp_path, p99_budget_ms=50.0, p99_grace_s=0.0)
+    try:
+        p.ctl.run_round(max_wait_s=5.0)
+        assert p.fleet.version == '2'
+        assert p.ctl.check(p99_ms=10.0) is None
+        assert p.ctl.check(p99_ms=400.0) == 'p99_regression'
+        assert p.fleet.version == '1'
+        assert p.fleet.stats()['rollbacks_by_reason'] == {
+            'p99_regression': 1}
+    finally:
+        p.close()
+
+
+def test_p99_trigger_respects_deploy_grace(tmp_path):
+    """A version flip's own compile-contention tail must not roll the
+    fresh deployment back: within p99_grace_s of a deploy the p99
+    trigger is suppressed; after it, the same reading fires."""
+    p = _Pipeline(tmp_path, p99_budget_ms=50.0, p99_grace_s=3600.0)
+    try:
+        p.ctl.run_round(max_wait_s=5.0)
+        assert p.fleet.version == '2'
+        assert p.ctl.check(p99_ms=400.0) is None  # in grace
+        assert p.fleet.version == '2'
+        p.ctl.p99_grace_s = 0.0
+        assert p.ctl.check(p99_ms=400.0) == 'p99_regression'
+        assert p.fleet.version == '1'
+    finally:
+        p.close()
+
+
+def test_auto_rollback_skipped_when_promote_interleaved(tmp_path):
+    """The watchdog's regression reading judged version N; if a
+    promote lands version N+1 before the rollback executes, rolling
+    back would discard the fresh deployment off stale evidence — the
+    rollback is skipped and the live window re-arms."""
+    p = _Pipeline(tmp_path, live_window=16)
+    try:
+        p.ctl.run_round(max_wait_s=5.0)
+        assert p.fleet.version == '2'
+        s = np.linspace(0.05, 0.95, 16)
+        p.ctl.record_live(s, (s < 0.5).astype(np.int64))
+        # the decision was made against '1' (a promote interleaved)
+        assert p.ctl.auto_rollback('live_auc_floor',
+                                   expect_version='1') is None
+        assert p.fleet.version == '2'  # untouched
+        assert p.fleet.stats()['rollbacks'] == 0
+        assert p.ctl.live_auc is None  # window re-armed
+    finally:
+        p.close()
+
+
+def test_single_class_live_window_is_discarded_not_judged(tmp_path):
+    """AUC is undefined on one label class; StreamingAUC's 0.5
+    sentinel sits below the default live floor, so publishing it would
+    roll back a healthy model every time a low-CTR window happens to
+    sample zero positives.  The window must be discarded."""
+    p = _Pipeline(tmp_path, live_window=16, live_floor=0.55)
+    try:
+        p.ctl.run_round(max_wait_s=5.0)
+        assert p.fleet.version == '2'
+        s = np.linspace(0.05, 0.95, 16)
+        assert p.ctl.record_live(s, np.zeros(16, np.int64)) is None
+        assert p.ctl.live_auc is None
+        assert p.ctl.check() is None          # no false rollback
+        assert p.fleet.version == '2'
+        # the next (two-class) window publishes normally
+        auc = p.ctl.record_live(s, (s > 0.5).astype(np.int64))
+        assert auc == 1.0
+    finally:
+        p.close()
+
+
+def test_single_class_holdout_neither_promotes_nor_rejects(tmp_path):
+    p = _Pipeline(tmp_path)
+    try:
+        one_class = [r for r in (p.writer.make_row()
+                                 for _ in range(128)) if r[2] == 1][:8]
+        verdict = p.ctl.gate(one_class)
+        assert verdict['undefined'] and not verdict['passed']
+        assert verdict['reasons'] == ['holdout_single_class']
+        # through the controller loop: the round stays trained — no
+        # deploy, no checkpoint rollback off a judgment-free holdout
+        real_run = p.trainer.run_round
+
+        def run_with_one_class_holdout(**kw):
+            rep = real_run(**kw)
+            rep['holdout_rows'] = one_class
+            return rep
+
+        p.trainer.run_round = run_with_one_class_holdout
+        rep = p.ctl.run_round(max_wait_s=5.0)
+        assert rep['outcome'] == 'trained'
+        assert rep['gate']['undefined']
+        assert p.fleet.version == '1'          # nothing deployed
+        assert p.trainer.step == rep['step']   # nothing rolled back
+    finally:
+        p.close()
+
+
+def test_stale_version_reading_never_rolls_back_successor(tmp_path):
+    """A live window filled (and published) under version N must not
+    trigger a rollback of version N+1 — the published reading carries
+    the version it judged, and check() ignores a stale stamp (the
+    promote/check race the action lock + stamp close)."""
+    p = _Pipeline(tmp_path, live_window=16, live_floor=0.55)
+    try:
+        # fill + publish a BAD reading judged against version '1'
+        s = np.linspace(0.05, 0.95, 16)
+        assert p.ctl.record_live(s, (s < 0.5).astype(np.int64)) < 0.2
+        # simulate the race: the deploy flipped the fleet to '2' but
+        # the controller's window reset has not run yet
+        p.export_fn(os.path.join(p.export_base, '2'))
+        p.fleet.deploy(p.export_base, version='2')
+        assert p.ctl.check() is None          # stale stamp: ignored
+        assert p.fleet.version == '2'
+        assert p.fleet.stats()['rollbacks'] == 0
+    finally:
+        p.close()
+
+
+def test_collect_round_restores_pending_rows_on_parse_error(tmp_path):
+    """Rows buffered into the pending partial batch across polls must
+    be put back when a later read raises — collect_round's
+    consumed==delivered promise holds on the exception path too."""
+    p = _Pipeline(tmp_path, rows=4, fleet=False)  # half a batch
+    try:
+        off0 = p.tail.offset
+        real = p.tail.read_rows
+        calls = []
+
+        def read_then_fail(n):
+            if not calls:
+                calls.append(1)
+                return real(n)  # 4 rows into pending
+            raise ValueError('malformed clickstream row (simulated)')
+
+        p.tail.read_rows = read_then_fail
+        with pytest.raises(ValueError, match='malformed'):
+            p.trainer.collect_round(max_wait_s=5.0)
+        assert p.tail.offset == off0  # pending rows put back
+    finally:
+        p.close()
+
+
+def test_forced_promote_clears_predecessor_gate_score(tmp_path):
+    """A gateless promote has no holdout score; inheriting the
+    previous version's promoted_auc would let check() roll back a
+    healthy forced model judged against a different model's number."""
+    p = _Pipeline(tmp_path, live_window=16, live_floor=0.2)
+    try:
+        rep = p.ctl.run_round(max_wait_s=5.0)
+        assert rep['outcome'] == 'promoted'
+        assert p.ctl.promoted_auc == rep['gate']['auc'] is not None
+        p.ctl.run_round(max_wait_s=5.0, force_promote=True)
+        assert p.ctl.promoted_auc is None
+        # an honest-but-lower live window does NOT fire a regression
+        # against the predecessor's gate score
+        s = np.linspace(0.05, 0.95, 16)
+        p.ctl.record_live(s, (s > 0.3).astype(np.int64))
+        assert p.ctl.live_auc is not None
+        assert p.ctl.check() is None
+        assert p.fleet.stats()['rollbacks'] == 0
+    finally:
+        p.close()
+
+
+def test_promote_prunes_freshness_stamps(tmp_path):
+    p = _Pipeline(tmp_path, keep_versions=1)
+    try:
+        for force in (False, True, True):
+            p.ctl.run_round(max_wait_s=5.0, force_promote=force)
+        # versions promoted: 2, 3, 4 — stamps only for what is still
+        # resolvable (on disk / live / rollback target), not one per
+        # promote forever
+        assert set(p.ctl._stamps) <= {'2', '3', '4'}
+        assert str(p.fleet.version) in p.ctl._stamps
+    finally:
+        p.close()
+
+
+# -- freshness SLO ------------------------------------------------------
+class _StubFleet(object):
+    """Just enough fleet surface for freshness/health unit tests."""
+    version = 'v1'
+
+    def deployment(self, prev=False):
+        return None
+
+
+class _StubTrainer(object):
+    pid = 'olstub'
+    step = 0
+    rounds = 0
+
+    def close(self):
+        pass
+
+
+def _mk_freshness_ctl(slo=0.15):
+    return OnlineController(
+        _StubTrainer(), _StubFleet(), export_base='/nonexistent',
+        export_fn=None, eval_fn=None, freshness_slo_s=slo,
+        register_health=True)
+
+
+def test_freshness_slo_violation_counted_once_per_window():
+    ctl = _mk_freshness_ctl(slo=0.15)
+    try:
+        assert ctl.check_freshness() < 0.15
+        assert ctl.slo_violations == 0 and not ctl.in_violation
+        time.sleep(0.2)
+        ctl.check_freshness()
+        assert ctl.slo_violations == 1 and ctl.in_violation
+        ctl.check_freshness()  # still stale: same window, same count
+        assert ctl.slo_violations == 1
+        # a fresh deploy ends the window...
+        ctl._stamps['v2'] = time.monotonic()
+        ctl._set_serving_version('v2')
+        ctl.check_freshness()
+        assert not ctl.in_violation and ctl.slo_violations == 1
+        # ...and the next staleness is a NEW counted violation
+        time.sleep(0.2)
+        ctl.check_freshness()
+        assert ctl.slo_violations == 2
+        text = observability.prometheus_text()
+        assert ('paddle_tpu_online_freshness_slo_violations_total'
+                '{pipeline="%s"} 2' % ctl.pid) in text
+    finally:
+        ctl.close()
+
+
+def test_freshness_degrades_healthz_endpoint():
+    import json
+    import urllib.request
+    ctl = _mk_freshness_ctl(slo=3600.0)
+    srv = observability.serve_metrics(port=0, host='127.0.0.1')
+    url = 'http://127.0.0.1:%d/healthz' % srv.port
+    try:
+        with urllib.request.urlopen(url) as r:
+            doc = json.loads(r.read())
+        assert doc['status'] == 'ok'
+        assert doc['checks']['online_freshness_%s' % ctl.pid]['ok']
+        # age past the SLO: the endpoint pages (503 + degraded)
+        ctl.freshness_slo_s = 1e-6
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read())
+        assert doc['status'] == 'degraded'
+        check = doc['checks']['online_freshness_%s' % ctl.pid]
+        assert not check['ok']
+        assert check['detail']['model_age_s'] > 0
+    finally:
+        srv.close()
+        ctl.close()
+    # close() unregisters the check: /healthz is clean again
+    ok, checks = observability.healthz_report()
+    assert ok and ('online_freshness_%s' % ctl.pid) not in checks
+
+
+def test_rollback_restores_old_version_age(tmp_path):
+    """Rolling back re-anchors freshness at the RESTORED version's
+    export time — a rollback to a stale model can itself violate the
+    SLO, which is the alert the pipeline wants."""
+    p = _Pipeline(tmp_path, live_window=16, live_floor=0.55,
+                  freshness_slo_s=3600.0)
+    try:
+        p.ctl.run_round(max_wait_s=5.0)  # promote v2: age ~0
+        age_v2 = p.ctl.model_age_s()
+        assert age_v2 < 3600.0
+        # backdate v2's stamp, then force v3 and roll back to it
+        with p.ctl._lock:
+            p.ctl._stamps['2'] = time.monotonic() - 9999.0
+        p.ctl.run_round(max_wait_s=5.0, force_promote=True)
+        assert p.ctl.model_age_s() < 100.0  # v3 is fresh
+        s = np.linspace(0.05, 0.95, 16)
+        p.ctl.record_live(s, (s < 0.5).astype(np.int64))
+        assert p.ctl.check() == 'live_auc_floor'
+        assert p.fleet.version == '2'
+        assert p.ctl.model_age_s() > 9000.0  # v2's real age came back
+        assert p.ctl.in_violation and p.ctl.slo_violations >= 1
+    finally:
+        p.close()
+
+
+# -- version GC under a live fleet (deploy->promote->gc race) -----------
+def test_gc_versions_never_touches_live_or_rollback_target(tmp_path):
+    p = _Pipeline(tmp_path, keep_versions=1)
+    try:
+        # promote twice: versions 2 and 3 exist; live=3, prev=2
+        p.ctl.run_round(max_wait_s=5.0)
+        p.ctl.run_round(max_wait_s=5.0, force_promote=True)
+        assert p.fleet.version == '3'
+        assert p.fleet.deployment()['version'] == '3'
+        assert p.fleet.deployment(prev=True)['version'] == '2'
+        # keep=1 would prune everything but the newest — yet the
+        # promote-time GC protected live + .prev, so only v1 is gone
+        left = sorted(e for e in os.listdir(p.export_base)
+                      if e.isdigit())
+        assert left == ['2', '3']
+        # the archived target is intact: rollback still works
+        assert p.fleet.rollback() == '2'
+        out, = p.fleet.predict(
+            _request_feed(p.writer.make_row()), timeout=30.0)
+        assert out.shape == (1, 2)
+    finally:
+        p.close()
+
+
+# -- trainer + fleet concurrently in one process ------------------------
+def test_trainer_and_fleet_concurrent_zero_drops(tmp_path):
+    """The scenario the fleet was built for: fine-tune rounds
+    (compiles included) run while the fleet serves — zero dropped or
+    failed requests, and the loop still promotes."""
+    p = _Pipeline(tmp_path, rows=2000, replicas=2)
+    errors, ok = [], [0]
+    stop = threading.Event()
+
+    def traffic():
+        rng = np.random.default_rng(5)
+        while not stop.is_set():
+            try:
+                out, = p.fleet.predict(
+                    _request_feed(p.writer.make_row()), timeout=60.0)
+                assert out.shape == (1, 2)
+                ok[0] += 1
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(e)
+            time.sleep(0.002)
+
+    th = threading.Thread(target=traffic, daemon=True)
+    try:
+        th.start()
+        time.sleep(0.2)
+        for _ in range(2):
+            rep = p.ctl.run_round(max_wait_s=10.0)
+            assert rep['outcome'] in ('promoted', 'gate_failed')
+        stop.set()
+        th.join(30.0)
+        assert errors == []
+        assert ok[0] > 0
+        st = p.fleet.stats()
+        assert st['failed'] == 0
+        assert st['requests'] > 0
+        assert p.trainer.rounds == 2
+    finally:
+        stop.set()
+        p.close()
