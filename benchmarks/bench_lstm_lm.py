@@ -1,12 +1,26 @@
 """BASELINE config 3: stacked-LSTM language model — tokens/s
 (benchmark/paddle/rnn counterpart; variable-length sequences ride the
 padded+lengths representation)."""
+import argparse
+
 import numpy as np
 
-from common import run_bench, on_tpu
+from common import ensure_mesh_devices, mesh_bench, run_bench, on_tpu
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--mesh', action='append', default=None,
+                    metavar='SPEC',
+                    help="multi-chip SPMD scaling run: one row per "
+                         "PADDLE_TPU_MESH spec (repeatable, e.g. "
+                         "--mesh off --mesh dp=2 --mesh fsdp=4); "
+                         "forces virtual host devices on CPU")
+    args = ap.parse_args(argv)
+    if args.mesh:
+        # must precede the first jax import (device count freezes)
+        ensure_mesh_devices(args.mesh)
+
     import paddle_tpu as fluid
     from paddle_tpu.models import rnn_lm
 
@@ -32,6 +46,13 @@ def main():
         mk = lambda: rng.integers(1, vocab, (batch, seq, 1)).astype(
             np.int32)
         return {'src': (mk(), ln), 'target': (mk(), ln)}
+
+    if args.mesh:
+        mesh_bench('stacked_lstm_mesh_scaling', batch * seq,
+                   lambda: build(dtype='float32'), feed, args.mesh,
+                   note='batch=%d seq=%d vocab=%d f32' % (batch, seq,
+                                                          vocab))
+        return
 
     run_bench('stacked_lstm_tokens_per_sec', batch * seq, build, feed,
               steps=100 if on_tpu() else 3,

@@ -1,11 +1,25 @@
 """BASELINE config 2b: VGG-16 ImageNet — img/s (benchmark/paddle/image/
 vgg.py counterpart)."""
+import argparse
+
 import numpy as np
 
-from common import run_bench, on_tpu
+from common import ensure_mesh_devices, mesh_bench, run_bench, on_tpu
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--mesh', action='append', default=None,
+                    metavar='SPEC',
+                    help="multi-chip SPMD scaling run: one row per "
+                         "PADDLE_TPU_MESH spec (repeatable, e.g. "
+                         "--mesh off --mesh dp=2 --mesh dp=4); forces "
+                         "virtual host devices on CPU")
+    args = ap.parse_args(argv)
+    if args.mesh:
+        # must precede the first jax import (device count freezes)
+        ensure_mesh_devices(args.mesh)
+
     import paddle_tpu as fluid
     from paddle_tpu.models import vgg
 
@@ -41,6 +55,13 @@ def main():
                     np.float32),
                 'label': rng.integers(0, classes, (batch, 1)).astype(
                     np.int32)}
+
+    if args.mesh:
+        # batch must divide the widest mesh for clean dp shards
+        mesh_bench('vgg16_mesh_scaling', batch,
+                   lambda: build(cast_bf16=False), feed, args.mesh,
+                   note='batch=%d hw=%d NHWC f32' % (batch, hw))
+        return
 
     # step_breakdown: the feed_s column (host staging on the step
     # critical path) vs compute_s, device-prefetch off/on

@@ -31,6 +31,147 @@ def on_tpu():
     return any(d.platform == 'tpu' for d in jax.devices())
 
 
+def ensure_mesh_devices(mesh_specs):
+    """Provision enough devices for the largest requested mesh BEFORE
+    any jax import: on CPU that means forcing virtual host devices via
+    XLA_FLAGS (a no-op when the flag is already set or a real TPU
+    backend provides the chips).  Call first thing in a bench main —
+    after jax initializes its backend the count is frozen."""
+    # parses the axis sizes locally: the canonical parser lives in
+    # paddle_tpu.distributed.spec_layout, but importing the package
+    # pulls in jax — exactly what must not happen before XLA_FLAGS is
+    # set.  Malformed pieces fail HERE, not later as a confusing
+    # device-count error
+    need = 1
+    for spec in mesh_specs:
+        n = 1
+        for piece in str(spec).split(','):
+            piece = piece.strip()
+            if not piece or piece in ('off', '1'):
+                continue
+            try:
+                n *= max(int(piece.split('=', 1)[1]), 1)
+            except (IndexError, ValueError):
+                raise SystemExit(
+                    "--mesh %r: piece %r is not axis=size" % (spec,
+                                                              piece))
+        need = max(need, n)
+    flags = os.environ.get('XLA_FLAGS', '')
+    if need > 1 and '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d'
+            % need).strip()
+    return need
+
+
+def mesh_bench(metric, unit_count, build, feed_fn, mesh_specs,
+               steps=None, note=None):
+    """Multi-chip SPMD scaling rows (PADDLE_TPU_MESH executor path):
+    one JSON line per mesh spec with per-device step time, modeled
+    collective ICI bytes/s, and per-device MFU — the scaling curve the
+    MULTICHIP_r*.json trajectory tracks.  ``mesh_specs`` entries are
+    PADDLE_TPU_MESH strings ('dp=2', 'fsdp=4', ...); 'off' (or '')
+    runs the single-logical-device baseline."""
+    import jax
+    import paddle_tpu as fluid
+    if steps is None:
+        steps = 8 if on_tpu() else 3
+    rows = []
+    # ONE feed set for every spec (feed_fn advances its RNG per call):
+    # with the seed pinned below, every row trains on identical data
+    # from identical init.  The loss column is then a sanity signal —
+    # same ballpark, finite — NOT an exact parity check: ulp-scale
+    # reduction-order differences between mesh layouts amplify
+    # chaotically over the warm+sample steps (measured: 2e-6 at step 3
+    # -> ~0.5 at step 12 on the LSTM LM).  Exact parity is pinned
+    # where it is provable, on few steps: tests/test_sharding.py
+    feeds = [feed_fn() for _ in range(steps)]
+    saved = os.environ.get('PADDLE_TPU_MESH')
+    try:
+        for spec in mesh_specs:
+            spec = (spec or '').strip()
+            off = spec in ('', 'off', '1')
+            if off:
+                os.environ.pop('PADDLE_TPU_MESH', None)
+            else:
+                os.environ['PADDLE_TPU_MESH'] = spec
+            devices = 1
+            if not off:
+                from paddle_tpu.distributed import _compat
+                devices = _compat.spmd_device_count(
+                    _compat.mesh_axes_from_flag(spec))
+            program, startup, loss = build()
+            # pinned seed: without it the executor derives the init
+            # PRNG from id(self), and the loss column stops being a
+            # cross-mesh parity signal
+            program.random_seed = startup.random_seed = 1234
+            scope = fluid.core.scope.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(
+                    fluid.TPUPlace(0) if on_tpu() else fluid.CPUPlace())
+                exe.run(startup)
+                out = exe.run_steps(program, feed=feeds,
+                                    fetch_list=[loss],
+                                    return_numpy=False)  # compile+warm
+                jax.block_until_ready(out[0])
+                samples = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    out = exe.run_steps(program, feed=feeds,
+                                        fetch_list=[loss],
+                                        return_numpy=False)
+                    jax.block_until_ready(out[0])
+                    samples.append(time.perf_counter() - t0)
+                loss_val = float(np.asarray(out[0]).ravel()[-1])
+                assert np.isfinite(loss_val), "loss went non-finite"
+                wall = sorted(samples)[len(samples) // 2]
+                step_s = wall / steps
+                rep = exe.last_step_report or {}
+                phases = rep.get('phases') or {}
+                row = {
+                    'metric': metric,
+                    'mesh': spec if not off else 'off',
+                    'devices': devices,
+                    'step_s': round(step_s, 6),
+                    'units_per_s': round(unit_count / step_s, 2),
+                    'units_per_s_per_device': round(
+                        unit_count / step_s / devices, 2),
+                    'loss': round(loss_val, 4),
+                }
+                coll = phases.get('collective')
+                if coll:
+                    per_step = coll['modeled_ici_bytes_per_step']
+                    row['modeled_ici_bytes_per_step'] = per_step
+                    row['modeled_ici_bytes_per_s'] = round(
+                        per_step / step_s, 1)
+                    if coll.get('est_wall_s') is not None:
+                        row['est_collective_s_per_step'] = round(
+                            coll['est_wall_s'] / max(rep.get('k', 1),
+                                                     1), 6)
+                comp = phases.get('compute') or {}
+                peak = os.environ.get('PADDLE_TPU_PEAK_TFLOPS')
+                if peak and comp.get('flops_per_step'):
+                    # per-device MFU: the global program FLOPs split
+                    # over the mesh, against one device's peak
+                    row['mfu_per_device'] = round(
+                        comp['flops_per_step'] / devices /
+                        (step_s * float(peak) * 1e12), 4)
+                mem = rep.get('memory') or {}
+                if mem.get('modeled_peak_bytes'):
+                    row['modeled_peak_bytes'] = mem[
+                        'modeled_peak_bytes']
+                if note:
+                    row['note'] = note
+                print(json.dumps(row))
+                rows.append(row)
+    finally:
+        if saved is None:
+            os.environ.pop('PADDLE_TPU_MESH', None)
+        else:
+            os.environ['PADDLE_TPU_MESH'] = saved
+    return rows
+
+
 def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
               note=None, dtype=None, compile_stats=False,
               amp_compare=None, step_breakdown=False):

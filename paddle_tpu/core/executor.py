@@ -153,6 +153,17 @@ class _ExecutorMetrics(object):
             '(PADDLE_TPU_VERIFY_IR, transpiler/verify.py) — each one '
             'is a pass bug or a malformed program caught before '
             'tracing').child()
+        self.collective_modeled_bytes = r.counter(
+            'paddle_tpu_executor_collective_modeled_bytes_total',
+            'modeled per-device ICI bytes moved by the collectives of '
+            'executed SPMD steps (PADDLE_TPU_MESH; ring closed forms '
+            'from the sharding pass + cost model), summed over steps '
+            '— the communication half of the roofline').child()
+        self.collectives_modeled = r.counter(
+            'paddle_tpu_executor_collectives_modeled_total',
+            'modeled collective operations (gradient allreduce, fsdp '
+            'reduce-scatter/all-gather) executed inside SPMD steps, '
+            'summed over steps').child()
 
 
 _exec_metrics = None
@@ -214,6 +225,15 @@ def _quiet_unused_donation(feed_arrays=None):
                 continue
         warnings.warn_explicit(w.message, w.category, w.filename,
                                w.lineno)
+
+
+def _shard_put(v, sh):
+    """Place one value with a NamedSharding, passing through values
+    already holding it (the steady-state no-op for device-resident
+    state under a stable mesh)."""
+    if isinstance(v, jax.Array) and getattr(v, 'sharding', None) == sh:
+        return v
+    return jax.device_put(v, sh)
 
 
 def _pass_plan_key(program):
@@ -729,6 +749,7 @@ class Executor(object):
         # PADDLE_TPU_TRACE_DIR / _TRACE_DUMP_ON_ERROR armed it
         tl = _tlm.ring_if_armed()
         mesh, dev = self._mesh_and_dev(program)
+        spmd = self._spmd_mesh(program) if mesh is None else None
         if tl is not None:
             tl.set_step(self._step)
             t_f0 = time.perf_counter()
@@ -736,29 +757,54 @@ class Executor(object):
         # every buffer the executor stages itself this call (host data
         # in, device_put here) is dead the moment the step consumes it
         # — donate it so XLA reuses the memory for step intermediates.
-        # A caller-staged jax.Array (or any mesh re-placement, where
-        # device_put may alias the caller's buffer) stays caller-owned
-        # and must NOT be donated.
-        feed_donate = (mesh is None and bool(feed_arrays) and
+        # This holds under a mesh too (the staging device_put below
+        # creates executor-owned replicated/sharded buffers); only a
+        # caller-staged jax.Array (where re-placement may alias the
+        # caller's buffer) stays caller-owned and must NOT be donated.
+        feed_donate = (bool(feed_arrays) and
                        not any(isinstance(v, jax.Array)
                                for v in feed_arrays.values()))
-        feed_arrays = self._stage_feed(feed_arrays, mesh, dev)
-        if tl is not None and feed_arrays:
-            tl.record('executor.feed_stage', 'feed', t0=t_f0,
-                      dur=time.perf_counter() - t_f0,
-                      args={'bytes': _nbytes(feed_arrays),
-                            'donated': feed_donate})
+        if spmd is None:
+            feed_arrays = self._stage_feed(feed_arrays, mesh, dev)
+        # host-side feed work so far (convert + non-mesh staging);
+        # the timeline event must NOT swallow the _get_plan call below
+        # (trace + XLA compile) into the feed phase.  Clock reads stay
+        # behind the armed guard (the disarmed zero-cost contract)
+        t_conv = (time.perf_counter() - t_f0) if tl is not None else 0.0
 
         plan = self._get_plan(program, block, scope, feed_arrays,
                               tuple(fetch_names), use_program_cache,
-                              mesh=mesh, feed_donate=feed_donate)
-        (fn, _raw, state_rw_names, state_ro_names) = plan
+                              mesh=mesh, feed_donate=feed_donate,
+                              spmd_mesh=spmd)
+        (fn, _raw, state_rw_names, state_ro_names, smeta) = plan
 
-        state_rw = self._stage_state(
-            {n: scope.get(n) for n in state_rw_names}, mesh, dev)
-        state_ro = self._stage_state(
-            {n: scope.get(n) for n in state_ro_names}, mesh, dev)
-        rng_key = jax.device_put(self._rng_key(program), dev)
+        t_s0 = time.perf_counter() if tl is not None else 0.0
+        if smeta is not None:
+            # sharded feed staging: each column lands on the mesh
+            # already split per the propagated plan (batch over dp/
+            # fsdp), so the pjit-lowered step starts from ICI-resident
+            # shards instead of re-scattering a replicated copy
+            feed_arrays = {n: _shard_put(v, smeta['feed_sh'][n])
+                           for n, v in feed_arrays.items()}
+        if tl is not None and feed_arrays:
+            tl.record('executor.feed_stage', 'feed', t0=t_f0,
+                      dur=t_conv + (time.perf_counter() - t_s0),
+                      args={'bytes': _nbytes(feed_arrays),
+                            'donated': feed_donate})
+
+        if smeta is not None:
+            state_rw = self._stage_state_spmd(scope, state_rw_names,
+                                              smeta['rw_sh'])
+            state_ro = self._stage_state_spmd(scope, state_ro_names,
+                                              smeta['ro_sh'])
+            rng_key = jax.device_put(self._rng_key(program),
+                                     smeta['key_sh'])
+        else:
+            state_rw = self._stage_state(
+                {n: scope.get(n) for n in state_rw_names}, mesh, dev)
+            state_ro = self._stage_state(
+                {n: scope.get(n) for n in state_ro_names}, mesh, dev)
+            rng_key = jax.device_put(self._rng_key(program), dev)
         self._step += 1
 
         em = _em() if _obs.enabled() else None
@@ -807,6 +853,8 @@ class Executor(object):
                 if ms and ms.get('bytes_in_use') is not None:
                     tl.counter_sample('paddle_tpu.device_bytes_in_use',
                                       ms['bytes_in_use'])
+            if smeta is not None:
+                self._note_collectives(tl, 1)
             for n, v in new_state.items():
                 scope.set(n, v)
             if return_numpy:
@@ -870,6 +918,105 @@ class Executor(object):
             return state
         return {n: jax.device_put(v, dev) for n, v in state.items()}
 
+    @staticmethod
+    def _stage_state_spmd(scope, names, shardings):
+        """Stage persistable state per the plan's NamedShardings — the
+        ONE staging rule all three SPMD call sites (run, run_steps,
+        the prefetch path) share; steady-state re-stages are no-ops
+        via the _shard_put pass-through."""
+        return {n: _shard_put(scope.get(n), shardings[n])
+                for n in names}
+
+    def _spmd_mesh(self, program):
+        """The PADDLE_TPU_MESH mesh for SPMD-lowering this program's
+        whole train step, or None: the flag must parse to axes, and a
+        program carrying its own parallel_do distribution keeps the
+        explicit shard_map path (one distribution mechanism per
+        program).  Mesh construction/caching lives in
+        distributed/_compat.py; the Mesh object participates in plan
+        keys (its identity is stable per normalized spec)."""
+        from ..distributed import _compat
+        axes = _compat.mesh_axes_from_flag()
+        if axes is None:
+            return None
+        key = (program._uid, program.version)
+        has_pdo = self._mesh_op_cache.get(key)
+        if has_pdo is None:
+            has_pdo = any(op.type == 'parallel_do'
+                          for b in program.blocks for op in b.ops)
+            self._mesh_op_cache[key] = has_pdo
+        if has_pdo:
+            return None
+        return _compat.mesh_for(axes)
+
+    def _build_shard_meta(self, prog, mesh, feed_names, rw_names,
+                          ro_names):
+        """NamedShardings for one plan's jit boundary, from the
+        sharding-propagation pass's plan (``prog._sharding_plan``):
+        feeds per the propagated feed table (batch over dp/fsdp),
+        persistable state per the param plan (fsdp shards params AND
+        optimizer accumulators; tp follows the transpiler plan),
+        everything unplanned replicated.  A pipeline fallback that
+        left no plan degrades to all-replicated — correct, just
+        unsharded."""
+        from ..distributed import _compat
+        plan = getattr(prog, '_sharding_plan', None) or {}
+        feeds = plan.get('feeds') or {}
+        params = plan.get('params') or {}
+        return {
+            'mesh': mesh,
+            'plan': plan,
+            'feed_sh': {n: _compat.named_sharding(mesh, feeds.get(n))
+                        for n in feed_names},
+            'rw_sh': {n: _compat.named_sharding(mesh, params.get(n))
+                      for n in rw_names},
+            'ro_sh': {n: _compat.named_sharding(mesh, params.get(n))
+                      for n in ro_names},
+            'key_sh': _compat.named_sharding(mesh, None),
+        }
+
+    def _xs_shardings(self, smeta, names):
+        """Per-column shardings for the [K, ...]-stacked run_steps
+        feed: the per-step spec shifted one dim right (dim0 is the
+        scan axis, never sharded)."""
+        from ..distributed import _compat
+        feeds = smeta['plan'].get('feeds') or {}
+        return {n: _compat.named_sharding(
+                    smeta['mesh'], (None,) + tuple(feeds.get(n) or ()))
+                for n in names}
+
+    def _note_collectives(self, tl, steps):
+        """Attribute the modeled ICI collectives of ``steps`` executed
+        SPMD steps: counters (modeled bytes + collective ops) and one
+        ``collective``-category timeline event, with an estimated wall
+        when PADDLE_TPU_ICI_GBPS names a link bandwidth.  The numbers
+        come from the cost model's pricing of the sharding pass's
+        collective table, cached per plan in last_graph_opt_report."""
+        cost = (self.last_graph_opt_report or {}).get('cost') or {}
+        coll = cost.get('collectives')
+        if not coll or not coll.get('ici_bytes'):
+            return None
+        nbytes = int(coll['ici_bytes']) * int(steps)
+        nops = len(coll.get('items') or ()) * int(steps)
+        if _obs.enabled():
+            em = _em()
+            em.collective_modeled_bytes.inc(nbytes)
+            em.collectives_modeled.inc(nops)
+        est = None
+        from ..flags import FLAGS
+        gbps = float(FLAGS.ici_gbps or 0.0)
+        if gbps > 0:
+            est = nbytes / (gbps * 1e9)
+        if tl is not None:
+            tl.record('executor.collective', 'collective',
+                      dur=est or 0.0,
+                      args={'modeled_ici_bytes': nbytes,
+                            'collectives': nops,
+                            'by_kind': dict(coll.get('by_kind') or {}),
+                            'est_wall_s': est})
+        return {'ici_bytes': nbytes, 'collectives': nops,
+                'est_wall_s': est, 'by_kind': coll.get('by_kind')}
+
     def _active_mesh(self, program):
         """The current mesh_guard mesh, when `program` contains an op
         that fans out over it (parallel_do) and the mesh is >1 device."""
@@ -924,19 +1071,23 @@ class Executor(object):
         return tuple(sorted(rw)), tuple(sorted(ro)), tuple(sorted(out))
 
     def _get_plan(self, program, block, scope, feed_arrays, fetch_names,
-                  use_cache, mesh=None, feed_donate=False):
+                  use_cache, mesh=None, feed_donate=False,
+                  spmd_mesh=None, mesh_off=False):
         feed_sig = tuple(
             (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
             for n in sorted(feed_arrays))
         state_rw_names, state_ro_names, state_out_names = \
             self._analyze_state(program, scope, set(feed_arrays))
         # mesh participates: a parallel_do program traced under a mesh
-        # embeds that mesh's shard_map in the compiled step.  Scope
+        # embeds that mesh's shard_map in the compiled step, and an
+        # SPMD mesh (PADDLE_TPU_MESH) bakes its NamedShardings into the
+        # jit boundary.  Scope
         # identity is its monotonic _uid, never id(): ids recycle after
         # gc and would alias a fresh scope's plans with a dead one's.
         # The pass configuration participates as ONE composite component
         # (pass_manager.plan_key): graph-opt level, AMP mode, verify
-        # mode, sparse/dense apply lowerings — a flip of any must not be
+        # mode, sparse/dense apply lowerings, mesh spec — a flip of any
+        # must not be
         # served a plan built under the old configuration.
         # feed_donate keys the donation variant: a plan jitted with the
         # feed argument donated must never serve a call whose feed
@@ -944,7 +1095,8 @@ class Executor(object):
         pm_key = _pass_plan_key(program)
         key = (program._uid, program.version, feed_sig, fetch_names,
                state_rw_names, state_ro_names, state_out_names,
-               scope._uid, mesh, pm_key, feed_donate)
+               scope._uid, mesh, spmd_mesh, mesh_off, pm_key,
+               feed_donate)
         if use_cache and key in self._cache:
             self._plan_fresh = False
             # keep the report describing THIS plan, not whichever plan
@@ -990,9 +1142,15 @@ class Executor(object):
                 feed_names=tuple(sorted(feed_arrays)),
                 # concrete feed shapes seed the cost model's shape
                 # propagation (declared -1 batch dims resolve to the
-                # real batch, so FLOPs/bytes are exact per step)
+                # real batch, so FLOPs/bytes are exact per step).
+                # mesh_off pins the sharding pass OFF for plans that
+                # will jit WITHOUT in_shardings (compile()/compile_raw
+                # AOT + serving consumers): a sharded analysis report
+                # over an unsharded executable would under-state
+                # per-device residency by the shard count
                 feed_specs={n: (tuple(v.shape), str(v.dtype))
-                            for n, v in feed_arrays.items()})
+                            for n, v in feed_arrays.items()},
+                **({'mesh': ''} if mesh_off else {}))
         except IRVerificationError:
             if _obs.enabled():
                 _em().ir_verify_failures.inc()
@@ -1055,10 +1213,22 @@ class Executor(object):
         # feed buffers — the donated feeds are exactly the extra reuse
         # headroom the PR-3 donation analysis reports (short-lived
         # intermediates can land in the dead feed buffers instead of
-        # growing peak HBM)
+        # growing peak HBM).  Under an SPMD mesh the same donation
+        # applies THROUGH the pjit boundary (sharded feed and state
+        # buffers are executor-staged too — run() proved ownership
+        # before asking for the donating variant).
+        smeta = None
+        jit_kw = {}
+        if spmd_mesh is not None:
+            smeta = self._build_shard_meta(
+                prog, spmd_mesh, set(feed_arrays), state_rw_names,
+                state_ro_names)
+            jit_kw['in_shardings'] = (smeta['feed_sh'], smeta['rw_sh'],
+                                      smeta['ro_sh'], smeta['key_sh'])
         fn = jax.jit(step_fn,
-                     donate_argnums=(0, 1) if feed_donate else (1,))
-        plan = (fn, step_fn, state_rw_names, state_ro_names)
+                     donate_argnums=(0, 1) if feed_donate else (1,),
+                     **jit_kw)
+        plan = (fn, step_fn, state_rw_names, state_ro_names, smeta)
         if use_cache:
             self._cache[key] = plan
             self._plan_reports[key] = self.last_graph_opt_report
@@ -1131,12 +1301,18 @@ class Executor(object):
                                 "adds %s" % extra if extra else '']))))
 
         mesh, dev = self._mesh_and_dev(program)
-        feed0 = self._stage_feed(_convert_feed(block, feeds[0]),
-                                 mesh, dev)
+        spmd = self._spmd_mesh(program) if mesh is None else None
+        feed0 = _convert_feed(block, feeds[0])
+        if spmd is None:
+            feed0 = self._stage_feed(feed0, mesh, dev)
 
         fn_plan = self._get_plan(program, block, scope, feed0,
-                                 fetch_names, True, mesh=mesh)
-        _fn, raw_fn, rw_names, ro_names = fn_plan
+                                 fetch_names, True, mesh=mesh,
+                                 spmd_mesh=spmd)
+        _fn, raw_fn, rw_names, ro_names, smeta = fn_plan
+        if smeta is not None:
+            feed0 = {n: _shard_put(v, smeta['feed_sh'][n])
+                     for n, v in feed0.items()}
 
         from ..flags import FLAGS
         prefetch = bool(FLAGS.device_prefetch) and stacked
@@ -1159,16 +1335,18 @@ class Executor(object):
             return self._run_steps_prefetch(
                 program, block, scope, feeds, k, feed0, fetch_names,
                 rw_names, ro_names, raw_fn, mesh, dev, em, report,
-                return_numpy, t_call)
+                return_numpy, t_call, smeta=smeta)
 
         multi, multi_fresh = self._multi_plan(
             program, scope, feed0, fetch_names, rw_names, ro_names,
-            mesh, raw_fn, k, stacked)
+            mesh if smeta is None else smeta['mesh'], raw_fn, k,
+            stacked, smeta=smeta)
 
         xs = None
         if stacked:
             tf = time.perf_counter()
-            xs = self._stack_chunk(feeds, 0, k, block, dev)
+            xs = self._stack_chunk(feeds, 0, k, block,
+                                   self._xs_placement(smeta, dev))
             report['feed_s'] = time.perf_counter() - tf
             report['feed_bytes'] = _nbytes(xs)
             if tl is not None:
@@ -1177,12 +1355,21 @@ class Executor(object):
                           args={'bytes': report['feed_bytes'],
                                 'steps': k})
 
-        state_rw = self._stage_state(
-            {n: scope.get(n) for n in rw_names}, mesh, dev)
-        state_ro = self._stage_state(
-            {n: scope.get(n) for n in ro_names}, mesh, dev)
-        key0 = jax.device_put(
-            jax.random.PRNGKey(self._base_seed(program)), dev)
+        if smeta is not None:
+            state_rw = self._stage_state_spmd(scope, rw_names,
+                                              smeta['rw_sh'])
+            state_ro = self._stage_state_spmd(scope, ro_names,
+                                              smeta['ro_sh'])
+            key0 = jax.device_put(
+                jax.random.PRNGKey(self._base_seed(program)),
+                smeta['key_sh'])
+        else:
+            state_rw = self._stage_state(
+                {n: scope.get(n) for n in rw_names}, mesh, dev)
+            state_ro = self._stage_state(
+                {n: scope.get(n) for n in ro_names}, mesh, dev)
+            key0 = jax.device_put(
+                jax.random.PRNGKey(self._base_seed(program)), dev)
         t0 = jnp.asarray(self._step, jnp.int32)
 
         if em is not None:
@@ -1227,7 +1414,7 @@ class Executor(object):
             return outs
 
     def _multi_plan(self, program, scope, feed0, fetch_names, rw_names,
-                    ro_names, mesh, raw_fn, k, stacked):
+                    ro_names, mesh, raw_fn, k, stacked, smeta=None):
         """Get-or-build the jitted K-step scan plan for one scan length.
 
         The composite pass-configuration key (_pass_plan_key — the same
@@ -1238,7 +1425,11 @@ class Executor(object):
         is donated along with the state: run_steps always builds the
         stack itself from host copies, so the buffer is executor-owned
         and dead once the scan consumed it — XLA gets the whole stack
-        back for intermediates instead of holding K dead batches."""
+        back for intermediates instead of holding K dead batches.
+        Under an SPMD mesh (``smeta``) the scan jits with the plan's
+        NamedShardings — per-step feeds batch-sharded (scan dim 0
+        replicated), state per the param plan — and the same xs+state
+        donation flows through the pjit boundary."""
         mkey = ('multi', program._uid, program.version, k, stacked,
                 fetch_names,
                 tuple((n, feed0[n].shape, str(feed0[n].dtype))
@@ -1249,8 +1440,17 @@ class Executor(object):
         if fresh:
             if _obs.enabled():
                 _em().plan_cache_misses.inc()
+            jit_kw = {}
+            if smeta is not None:
+                jit_kw['in_shardings'] = (
+                    smeta['feed_sh'],
+                    self._xs_shardings(smeta, set(feed0))
+                    if stacked else None,
+                    smeta['rw_sh'], smeta['ro_sh'],
+                    smeta['key_sh'], smeta['key_sh'])
             multi = jax.jit(make_multi_step_fn(raw_fn, stacked, k),
-                            donate_argnums=(1, 2) if stacked else (2,))
+                            donate_argnums=(1, 2) if stacked else (2,),
+                            **jit_kw)
             self._cache[mkey] = multi
         elif _obs.enabled():
             _em().plan_cache_hits.inc()
@@ -1287,10 +1487,21 @@ class Executor(object):
                       args={'donated_state_bytes': _nbytes(state_rw)})
         return out
 
-    def _stack_chunk(self, feeds, lo, hi, block, dev):
+    def _xs_placement(self, smeta, dev):
+        """Placement argument for staging stacked feed columns: the
+        per-column NamedShardings under an SPMD mesh (each chunk lands
+        pre-sharded over the batch axis), the single device/sharding
+        otherwise — consumed by runtime/prefetch.stage_columns."""
+        if smeta is None:
+            return dev
+        return self._xs_shardings(
+            smeta, set(smeta['feed_sh']))
+
+    def _stack_chunk(self, feeds, lo, hi, block, placement):
         """Stack feeds[lo:hi] into device-staged [hi-lo, ...] columns
         (the one-shot path; the chunked path pre-converts and validates
         every feed before its first dispatch instead)."""
+        from ..runtime.prefetch import stage_columns
         cols = {}
         want = None
         for i, f in enumerate(feeds[lo:hi]):
@@ -1303,13 +1514,14 @@ class Executor(object):
                 raise _feed_column_error(lo + i, set(fa), want)
             for n, v in fa.items():
                 cols.setdefault(n, []).append(np.asarray(v))
-        return {n: jax.device_put(_stack_feed_col(n, vs), dev)
-                for n, vs in cols.items()}
+        return stage_columns(
+            {n: _stack_feed_col(n, vs) for n, vs in cols.items()},
+            placement)
 
     def _run_steps_prefetch(self, program, block, scope, feeds, k,
                             feed0, fetch_names, rw_names, ro_names,
                             raw_fn, mesh, dev, em, report,
-                            return_numpy, t_call):
+                            return_numpy, t_call, smeta=None):
         """Device-resident run_steps (PADDLE_TPU_DEVICE_PREFETCH): the
         K-step feed stack is staged in chunks through a double-buffered
         pipeline — the host stacks and device_puts chunk c+1 while the
@@ -1370,14 +1582,17 @@ class Executor(object):
             conv.append(fa)
         report['feed_s'] += time.perf_counter() - tv
 
+        from ..runtime.prefetch import stage_columns
+        xs_placement = self._xs_placement(smeta, dev)
+
         def make_thunk(lo, hi):
             def thunk():
                 ts = time.perf_counter()
-                xs = {n: jax.device_put(
-                          np.stack([conv[i][n] for i in range(lo, hi)])
-                          .astype(col_dtypes[n], copy=False),
-                          dev)
-                      for n in col_shapes}
+                xs = stage_columns(
+                    {n: np.stack([conv[i][n] for i in range(lo, hi)])
+                        .astype(col_dtypes[n], copy=False)
+                     for n in col_shapes},
+                    xs_placement)
                 dt = time.perf_counter() - ts
                 nb = _nbytes(xs)
                 if started[0]:
@@ -1398,12 +1613,21 @@ class Executor(object):
                 return lo, hi, xs
             return thunk
 
-        state_rw = self._stage_state(
-            {n: scope.get(n) for n in rw_names}, mesh, dev)
-        state_ro = self._stage_state(
-            {n: scope.get(n) for n in ro_names}, mesh, dev)
-        key0 = jax.device_put(
-            jax.random.PRNGKey(self._base_seed(program)), dev)
+        if smeta is not None:
+            state_rw = self._stage_state_spmd(scope, rw_names,
+                                              smeta['rw_sh'])
+            state_ro = self._stage_state_spmd(scope, ro_names,
+                                              smeta['ro_sh'])
+            key0 = jax.device_put(
+                jax.random.PRNGKey(self._base_seed(program)),
+                smeta['key_sh'])
+        else:
+            state_rw = self._stage_state(
+                {n: scope.get(n) for n in rw_names}, mesh, dev)
+            state_ro = self._stage_state(
+                {n: scope.get(n) for n in ro_names}, mesh, dev)
+            key0 = jax.device_put(
+                jax.random.PRNGKey(self._base_seed(program)), dev)
         base = self._step
         if em is not None:
             # steps_total counts per COMPLETED chunk below, not k
@@ -1424,7 +1648,9 @@ class Executor(object):
                         tl0.set_step(base + lo)
                     multi, fresh = self._multi_plan(
                         program, scope, feed0, fetch_names, rw_names,
-                        ro_names, mesh, raw_fn, hi - lo, True)
+                        ro_names,
+                        mesh if smeta is None else smeta['mesh'],
+                        raw_fn, hi - lo, True, smeta=smeta)
                     ys, state_rw, last_extra = self._dispatch_multi(
                         multi, fresh, em, feed0, xs, state_rw, state_ro,
                         key0, jnp.asarray(base + lo, jnp.int32))
@@ -1572,6 +1798,19 @@ class Executor(object):
         report['phases'] = {'feed': feed_phase,
                             'compute': compute_phase,
                             'update': update_phase}
+        # comm attribution (SPMD plans): the modeled ICI bytes the
+        # k steps' collectives moved, priced by the cost model from
+        # the sharding pass's table — attributed like feed/compute/
+        # update, with a wall estimate when PADDLE_TPU_ICI_GBPS is set
+        noted = self._note_collectives(_tlm.ring_if_armed(), k)
+        if noted is not None:
+            report['phases']['collective'] = {
+                'modeled_ici_bytes': noted['ici_bytes'],
+                'modeled_ici_bytes_per_step': noted['ici_bytes'] // k,
+                'collectives': noted['collectives'],
+                'by_kind': dict(noted.get('by_kind') or {}),
+                'est_wall_s': noted['est_wall_s'],
+            }
         report['cost'] = cost
         measured = _tlm.device_memory_stats(self._memory_device())
         report['memory'] = self._memory_report(cost, measured)
@@ -1674,8 +1913,14 @@ class Executor(object):
         for name, value in feed.items():
             var = block.vars.get(name)
             feed_arrays.update(_to_feed_arrays(name, value, var))
-        fn, raw, rw_names, ro_names = self._get_plan(
-            program, block, scope, feed_arrays, tuple(fetch_names), True)
+        # compile()/compile_raw() hand their fn to AOT/export/serving
+        # consumers (and run_sharded re-jits with its OWN shard plan):
+        # the flag mesh is pinned off so the plan — and its cost/memory
+        # report — describes the single-logical-device executable these
+        # callers actually get
+        fn, raw, rw_names, ro_names, _smeta = self._get_plan(
+            program, block, scope, feed_arrays, tuple(fetch_names),
+            True, mesh_off=True)
         state_rw = {n: scope.get(n) for n in rw_names}
         state_ro = {n: scope.get(n) for n in ro_names}
         rng_key = self._rng_key(program)

@@ -1,8 +1,10 @@
 from . import launch, transpiler
 from .pipeline import PipelineTranspiler
+from .spec_layout import SpecLayout, parse_mesh_spec
 from .tensor_parallel import TensorParallel, TensorParallelTranspiler
 from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
 
 __all__ = ['transpiler', 'launch', 'DistributeTranspiler',
            'SimpleDistributeTranspiler', 'PipelineTranspiler',
-           'TensorParallelTranspiler', 'TensorParallel']
+           'TensorParallelTranspiler', 'TensorParallel',
+           'SpecLayout', 'parse_mesh_spec']

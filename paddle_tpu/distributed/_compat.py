@@ -1,0 +1,131 @@
+"""Version-compat shims for the SPMD lowering path.
+
+The container toolchain pins a jax whose public surface moved between
+releases: ``jax.shard_map`` only exists as
+``jax.experimental.shard_map.shard_map`` here, and newer mesh helpers
+(``jax.make_mesh``) are absent.  Every sharding-propagation consumer
+(transpiler/sharding.py, core/executor.py, the benches) resolves those
+APIs through this module — the PR-4 ``ops/pallas/_compat.py`` pattern —
+so the SPMD path degrades per-feature instead of failing at import on
+whichever jax the host ships.
+
+Also home of the mesh-flag plumbing: ``PADDLE_TPU_MESH`` parses once
+per lookup (cheap string work), and the constructed ``jax.sharding.Mesh``
+objects are cached per normalized spec so every plan build under one
+configuration shares one Mesh instance (Mesh identity participates in
+executor plan-cache keys).
+"""
+import threading
+
+__all__ = ['resolve_shard_map', 'has_shard_map', 'mesh_axes_from_flag',
+           'mesh_for', 'named_sharding', 'spmd_device_count']
+
+_lock = threading.Lock()
+_mesh_cache = {}  # canonical spec string -> Mesh
+
+
+def resolve_shard_map():
+    """The shard_map entry point of whatever jax is installed, or None.
+
+    Prefers the stable ``jax.shard_map`` (newer jax), falls back to
+    ``jax.experimental.shard_map.shard_map`` (the container's 0.4.x),
+    and returns None when neither exists — callers must gate, never
+    assume (the pjit/GSPMD lowering below needs no shard_map at all,
+    so absence only disables the explicitly-mapped code paths).
+    """
+    import jax
+    sm = getattr(jax, 'shard_map', None)
+    if sm is not None and not _is_deprecated_stub(jax, 'shard_map'):
+        return sm
+    try:
+        from jax.experimental.shard_map import shard_map as esm
+        return esm
+    except Exception:
+        return None
+
+
+def _is_deprecated_stub(mod, name):
+    """jax 0.4.x raises through a module __getattr__ deprecation shim
+    for names that LOOK present via getattr with a default — probe by
+    real attribute access."""
+    try:
+        getattr(mod, name)
+        return False
+    except AttributeError:
+        return True
+
+
+def has_shard_map():
+    return resolve_shard_map() is not None
+
+
+def mesh_axes_from_flag(value=None):
+    """Normalized ``(('dp', 2), ('tp', 2))``-style axes tuple from the
+    PADDLE_TPU_MESH flag (or an explicit ``value``), or None when the
+    mesh is off.  Parsing/validation lives in
+    distributed/spec_layout.py — ONE spec vocabulary."""
+    from .spec_layout import parse_mesh_spec
+    if value is None:
+        from ..flags import FLAGS
+        value = FLAGS.mesh
+    value = (value or '').strip()
+    if not value:
+        return None
+    return parse_mesh_spec(value)
+
+
+def mesh_key(value=None):
+    """The canonical plan-cache key component for the mesh flag: the
+    normalized ``axis=size`` string, or None when off."""
+    axes = mesh_axes_from_flag(value)
+    if axes is None:
+        return None
+    return ','.join('%s=%d' % a for a in axes)
+
+
+def spmd_device_count(axes):
+    n = 1
+    for _name, size in axes:
+        n *= int(size)
+    return n
+
+
+def mesh_for(axes):
+    """The cached ``jax.sharding.Mesh`` for a normalized axes tuple.
+
+    Raises with an actionable message when the backend exposes fewer
+    devices than the mesh needs (on CPU:
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+    """
+    key = ','.join('%s=%d' % a for a in axes)
+    with _lock:
+        m = _mesh_cache.get(key)
+    if m is not None:
+        return m
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    n = spmd_device_count(axes)
+    devices = jax.devices()
+    if len(devices) < n:
+        raise RuntimeError(
+            "PADDLE_TPU_MESH=%s needs %d devices but the %s backend "
+            "exposes %d; on CPU force host devices with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=%d"
+            % (key, n, devices[0].platform if devices else '?',
+               len(devices), n))
+    arr = np.array(devices[:n]).reshape([s for _n, s in axes])
+    m = Mesh(arr, tuple(name for name, _s in axes))
+    with _lock:
+        _mesh_cache[key] = m
+    return m
+
+
+def named_sharding(mesh, spec):
+    """Tuple-spec -> NamedSharding.  ``spec`` is the hashable per-dim
+    tuple the sharding pass stamps (each entry an axis name, a tuple of
+    axis names, or None); None means fully replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if spec is None:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(*spec))

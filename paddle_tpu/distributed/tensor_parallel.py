@@ -21,25 +21,14 @@ The same transpiled program still runs single-device (the op degrades
 to the fused single-chip head when no tp axis is bound), mirroring how
 the reference's trainer program remains a plain Program.
 """
-import re
-
 import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
 from ..core.program import default_main_program
 from ..parallel import api
-
-__all__ = ['TensorParallelTranspiler', 'TensorParallel']
-
-# optimizer accumulator naming: _add_accumulator creates
-# unique_name('<param>_<stem>') = '<param>_<stem>_<n>' with the PARAM's
-# shape; the stems are the literal _add_accumulator first arguments in
-# optimizer.py (ftrl's are plain 'squared'/'linear').  Beta-pow scalars
-# are shape [1] and never pass the shape match.
-_ACC_SUFFIX = re.compile(
-    r'(moment\d?|velocity|inf_norm|mean_square|momentum|'
-    r'squared|linear|avg_squared_grad|avg_squared_update)_\d+$')
+from .spec_layout import ACC_SUFFIX as _ACC_SUFFIX  # noqa: F401 (compat)
+from .spec_layout import extend_to_accumulators
 
 
 class TensorParallel(object):
@@ -142,40 +131,22 @@ class TensorParallelTranspiler(object):
             plan[name] = P(*spec)
 
         self._plan = plan
+        # the sharding-propagation pass (transpiler/sharding.py) folds
+        # this per-parameter plan into its canonical spec table — ONE
+        # spec source — by reading it off the program; accumulators are
+        # extended there (and in shard_plan()) at consumption time, so
+        # a minimize() that runs after transpile() is still covered
+        self.program._tp_shard_plan = dict(plan)
         self.program._bump_version()  # rewritten ops: invalidate caches
         return self
 
     def _with_accumulators(self, plan):
-        """Extend the param plan to the optimizer accumulator vars of
-        every sharded param: a moment/velocity buffer has the param's
-        shape and would otherwise fall through _state_sharding to the
-        replicate heuristic — each chip holding a full [D, V] moment
-        per sharded head undoes the memory win the plan exists for
-        (ADVICE.md).  Matched by the `<param>_<stem>_<n>` accumulator
-        naming plus an exact shape match; anything else (beta-pow
-        scalars, unrelated vars) keeps its default sharding.  Computed
-        at shard_plan() time, not transpile() time, so accumulators
-        created by a minimize() that runs after transpile() are still
-        picked up."""
-        out = dict(plan)
-        if self.program is None:
-            return out
-        gb = self.program.global_block()
-        for var in self.program.list_vars():
-            name = var.name
-            if not getattr(var, 'persistable', False) or name in out:
-                continue
-            for pname, spec in plan.items():
-                if not name.startswith(pname + '_'):
-                    continue
-                if not _ACC_SUFFIX.fullmatch(name[len(pname) + 1:]):
-                    continue
-                pvar = gb.var_recursive(pname)
-                if tuple(var.shape) != tuple(pvar.shape):
-                    continue
-                out[name] = spec
-                break
-        return out
+        """Extend the param plan to optimizer accumulators — delegates
+        to the shared distributed/spec_layout.py rule (the memory win
+        argument lives there).  Computed at shard_plan() time, not
+        transpile() time, so accumulators created by a minimize() that
+        runs after transpile() are still picked up."""
+        return extend_to_accumulators(self.program, plan)
 
     def shard_plan(self):
         """{var_name: PartitionSpec} over the tp axis: the sharded

@@ -299,6 +299,35 @@ DEFINE_int('online_keep_versions', 4,
            'each promote, io.gc_versions prunes numbered version dirs '
            'beyond the newest N, never touching the fleet\'s live '
            'version or its .prev rollback target')
+DEFINE_string('mesh', '',
+              'SPMD device mesh for whole-train-step pjit lowering, as '
+              'comma-separated axis=size pairs over the canonical axis '
+              'vocabulary dp (data), fsdp (params+optimizer-state '
+              'sharding), tp (tensor parallel): e.g. "dp=2", '
+              '"dp=4,tp=2", "fsdp=8".  When set, the executor builds a '
+              'jax Mesh over the first prod(sizes) devices, the '
+              'sharding-propagation pass (transpiler/sharding.py) '
+              'stamps per-op input/output PartitionSpecs on the plan '
+              'IR, and the whole step jits with the resulting '
+              'NamedShardings: feeds batch-shard over dp (or fsdp when '
+              'no dp axis exists), fsdp shards every divisible '
+              'parameter AND its optimizer accumulators, tp follows '
+              'the TensorParallelTranspiler plan, and gradient '
+              'allreduce lowers to ICI collectives inside the one '
+              'compiled step.  Empty (default) is off — bitwise the '
+              'pre-mesh executor.  Re-read per plan build and part of '
+              'the composite plan-cache key, so flips take effect '
+              'without a restart.  CPU smoke: force host devices with '
+              'XLA_FLAGS=--xla_force_host_platform_device_count=8')
+DEFINE_float('ici_gbps', 0.0,
+             'modeled ICI link bandwidth in GB/s for the collective '
+             'cost term: when >0, the executor annotates the '
+             '"collective" phase of last_step_report (and the '
+             'timeline event) with an estimated wall time = modeled '
+             'ICI bytes / this bandwidth, next to the exact byte '
+             'count the ring-allreduce closed form produces either '
+             'way.  0 (default) reports bytes only — no fake seconds '
+             'on hardware whose interconnect was never measured')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

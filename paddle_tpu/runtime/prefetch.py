@@ -16,9 +16,28 @@ import threading
 
 from .native import NativeQueue
 
-__all__ = ['prefetch_reader', 'xmap_native', 'device_prefetch']
+__all__ = ['prefetch_reader', 'xmap_native', 'device_prefetch',
+           'stage_columns']
 
 _END = b'\x00__PTQ_END__'
+
+
+def stage_columns(cols, placement):
+    """Stage stacked host feed columns onto the device(s).
+
+    ``placement`` is either one device/sharding (single-device
+    run_steps — every column lands there) or a ``{name: NamedSharding}``
+    dict (SPMD mesh): each column is device_put pre-split per its
+    propagated spec — batch shards go straight to their owning devices,
+    so the compiled scan starts from mesh-resident shards instead of
+    scattering a replicated copy on every chunk.  The single home of
+    that placement rule for both the one-shot stack and the
+    double-buffered chunk thunks."""
+    import jax
+    if isinstance(placement, dict):
+        return {n: jax.device_put(v, placement[n])
+                for n, v in cols.items()}
+    return {n: jax.device_put(v, placement) for n, v in cols.items()}
 
 
 def device_prefetch(thunks):

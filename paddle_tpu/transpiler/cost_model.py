@@ -24,6 +24,11 @@ Model, per op (classification lives in ``registry.op_traits().cost``):
 - **waived ops** (``WAIVED_OPS`` + control-flow/env/sub-block ops): no
   per-op dense-tensor verdict exists; they are reported in
   ``coverage['waived']``, never silently costed 0.
+- **collectives** (sharded plans only): the sharding pass's table of
+  implied ICI collectives priced with the ring closed forms — gradient
+  allreduce moves ``2(N-1)/N x bytes`` per device, reduce-scatter /
+  all-gather halves move ``(N-1)/N`` each — under ``'collectives'``;
+  the executor attributes them as the ``collective`` step phase.
 
 Shapes resolve through the same machinery the IR verifier trusts: the
 executor's concrete feed specs seed an environment that
@@ -420,6 +425,38 @@ def _autodiff_slice(ops, idx, loss_name):
     return picked
 
 
+ICI_BASIS = ('ring collectives: allreduce moves 2(N-1)/N x payload '
+             'bytes per device over ICI (reduce-scatter ring + '
+             'all-gather ring); reduce_scatter / all_gather move '
+             '(N-1)/N each')
+
+
+def _collective_costs(program):
+    """Price the sharding pass's collective table with the ring closed
+    forms — the **collective cost term**: per-step bytes each device
+    moves over ICI, attributed per collective op.  None when the
+    program was not sharded (single-device plans carry no comm)."""
+    plan = getattr(program, '_sharding_plan', None)
+    if not plan or not plan.get('collectives'):
+        return None
+    from . import sharding as _sh
+    items = []
+    total = 0
+    by_kind = {}
+    for it in plan['collectives']:
+        ici = _sh.collective_ici_bytes(it['kind'], it['n'], it['bytes'])
+        items.append(dict(it, ici_bytes=ici))
+        total += ici
+        by_kind[it['kind']] = by_kind.get(it['kind'], 0) + ici
+    return {
+        'basis': ICI_BASIS,
+        'mesh_axes': tuple(plan.get('mesh_axes') or ()),
+        'items': items,
+        'by_kind': by_kind,
+        'ici_bytes': int(total),
+    }
+
+
 def analyze_cost(program, fetch_names=(), feed_specs=None):
     """Walk the (post-rewrite) global block and emit the cost report.
 
@@ -522,7 +559,10 @@ def analyze_cost(program, fetch_names=(), feed_specs=None):
         _spec_bytes((tuple(v.shape), v.dtype), unk)
         for v in program.list_vars() if v.persistable and v.shape)
 
+    collectives = _collective_costs(program)
+
     return {
+        'collectives': collectives,
         'flops_basis': FLOPS_BASIS,
         'per_op': per_op,
         'per_role': per_role,

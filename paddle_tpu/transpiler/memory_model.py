@@ -37,6 +37,12 @@ Model, in op order over the global block:
   policy claims: ``'dots'`` keeps only matmul-shaped outputs
   (``registry.COST_MAC``) live across the fwd/bwd boundary, ``'full'``
   keeps none (everything recomputes from params + feeds).
+- **Sharded plans** (``PADDLE_TPU_MESH``): every name the sharding
+  pass assigned a shard divisor — fsdp-sharded params and optimizer
+  accumulators, dp-sharded feeds and activations — is resident at
+  1/K of its bytes per device, so modeled peak HBM reflects what one
+  chip actually holds (``sharding`` block carries the unsharded total
+  for comparison).
 - **Waived ops** (``WAIVED_OPS`` + control-flow/env/sub-block ops):
   outputs whose dense extent is data-dependent (SelectedRows handles,
   LoDTensorArrays, beam state) carry no per-op live-bytes verdict; they
@@ -105,9 +111,22 @@ def analyze_memory(program, fetch_names=(), feed_specs=None,
 
     persist_names = {v.name for v in program.list_vars()
                      if v.persistable}
+    # per-name shard divisors from the sharding-propagation pass
+    # (PADDLE_TPU_MESH): a var sharded K ways is resident at 1/K of
+    # its bytes PER DEVICE — fsdp's whole point is that params and
+    # optimizer accumulators divide, and the model must say so
+    shard_plan = getattr(program, '_sharding_plan', None) or {}
+    divisors = shard_plan.get('divisors') or {}
+
+    def _div(name):
+        return max(int(divisors.get(name, 1)), 1)
+
     unk = [0]
-    persistable_bytes = sum(
+    persistable_bytes_unsharded = sum(
         _cm._spec_bytes((tuple(v.shape), v.dtype), unk)
+        for v in program.list_vars() if v.persistable and v.shape)
+    persistable_bytes = sum(
+        _cm._spec_bytes((tuple(v.shape), v.dtype), unk) // _div(v.name)
         for v in program.list_vars() if v.persistable and v.shape)
 
     # -- size every name the walk will see ----------------------------
@@ -147,6 +166,12 @@ def analyze_memory(program, fetch_names=(), feed_specs=None,
                        if n not in sizes and n not in persist_names]
             if missing:
                 no_verdict.setdefault(op.type, sorted(missing))
+
+    # apply the shard divisors to every sized name (feeds and
+    # batch-sharded intermediates divide like the persistables above)
+    if divisors:
+        for n in list(sizes):
+            sizes[n] //= _div(n)
 
     # -- liveness intervals -------------------------------------------
     n_ops = len(ops)
@@ -213,12 +238,21 @@ def analyze_memory(program, fetch_names=(), feed_specs=None,
             live -= sizes[n]
 
     watermark = sorted(per_op, key=lambda e: -e['live_bytes'])[:top_k]
+    sharding_block = None
+    if divisors:
+        sharding_block = {
+            'mesh_axes': tuple(shard_plan.get('mesh_axes') or ()),
+            'sharded_names': len(divisors),
+            'persistable_bytes_unsharded':
+                int(persistable_bytes_unsharded),
+        }
     return {
         'peak_bytes': int(peak),
         'peak_intermediate_bytes': int(
             peak_entry['intermediate_bytes'] if peak_entry else 0),
         'persistable_bytes': int(persistable_bytes),
         'feed_bytes': int(feed_bytes),
+        'sharding': sharding_block,
         'remat_level': remat_level,
         'donated_feed_credit': bool(donate_feeds),
         'watermark': [dict(e) for e in watermark],

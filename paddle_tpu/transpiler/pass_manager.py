@@ -11,11 +11,16 @@ into an explicit pipeline:
 - every pass is **registered** (``@register_pass``) with a declared
   ``order``, a ``report_key``, and a kind (``rewrite`` | ``analysis``);
   tools/check_pass_registry.py statically audits the registry and
-  cross-checks it against the verifier mutation-test matrix.  The
-  analysis tail is donation (order 90), the static cost model
-  (order 95, transpiler/cost_model.py — after AMP so low-precision
-  bytes count), then the liveness-based peak-memory model (order 96,
-  transpiler/memory_model.py, nested under the cost report).
+  cross-checks it against the verifier mutation-test matrix.  After
+  AMP comes sharding propagation (order 85, transpiler/sharding.py,
+  enabled by PADDLE_TPU_MESH — stamps per-op PartitionSpecs + the
+  SPMD plan the executor pjit-lowers with); the analysis tail is
+  donation (order 90), the static cost model (order 95,
+  transpiler/cost_model.py — after AMP so low-precision bytes count,
+  after sharding so the collective table is priced), then the
+  liveness-based peak-memory model (order 96,
+  transpiler/memory_model.py, nested under the cost report, dividing
+  sharded residency by the plan's shard divisors).
 - ``run_pipeline`` builds the plan for the current configuration
   (graph-opt level, AMP mode), runs each pass on an isolated copy —a
   crashing pass is skipped with a per-pass report entry, it can no
@@ -80,7 +85,11 @@ def registered_passes():
     return sorted(PASSES.values(), key=lambda p: p.order)
 
 
-PassConfig = collections.namedtuple('PassConfig', ['level', 'amp_mode'])
+PassConfig = collections.namedtuple('PassConfig',
+                                    ['level', 'amp_mode', 'mesh'])
+# mesh defaults to None (off) so positional (level, amp) callers and
+# the registry checker's build_plan(level, amp) probes keep working
+PassConfig.__new__.__defaults__ = (None,)
 
 
 class PassContext(object):
@@ -89,11 +98,14 @@ class PassContext(object):
     exactly like the PR-3 driver did)."""
 
     def __init__(self, fetch_names, feed_names, pinned, amp_mode,
-                 feed_specs=None):
+                 feed_specs=None, mesh_axes=None):
         self.fetch_names = tuple(fetch_names)
         self.feed_names = tuple(feed_names)
         self.pinned = set(pinned)
         self.amp_mode = amp_mode
+        # normalized PADDLE_TPU_MESH axes tuple (('dp', 2), ...) or
+        # None — the sharding-propagation pass's mesh config
+        self.mesh_axes = tuple(mesh_axes) if mesh_axes else None
         # {name: (shape, dtype)} concrete feed shapes from the executor
         # — the cost-model pass seeds its shape propagation with them so
         # -1 batch dims resolve to the real batch
@@ -169,6 +181,20 @@ def _amp(program, ctx):
     return {'amp': report}
 
 
+@register_pass('sharding', 85, 'sharding',
+               enabled=lambda cfg: bool(cfg.mesh))
+def _sharding(program, ctx):
+    # after graph-opt and AMP (it must see exactly the ops that will
+    # trace), before the analysis tail (cost prices its collective
+    # table, memory divides by its shard divisors): propagate per-op
+    # PartitionSpecs over the mesh and stamp the plan the executor
+    # pjit-lowers with
+    from . import sharding as sharding_mod
+    return {'sharding': sharding_mod.apply_sharding(
+        program, ctx.mesh_axes, fetch_names=ctx.fetch_names,
+        feed_names=ctx.feed_names, feed_specs=ctx.feed_specs)}
+
+
 @register_pass('donation', 90, 'donation', kind='analysis',
                enabled=lambda cfg: cfg.level >= 1)
 def _donation(program, ctx):
@@ -215,8 +241,8 @@ def resolve_level(program=None, level=None):
     return lv
 
 
-def build_plan(level, amp_mode):
-    cfg = PassConfig(level, amp_mode)
+def build_plan(level, amp_mode, mesh=None):
+    cfg = PassConfig(level, amp_mode, mesh)
     return [p for p in registered_passes() if p.enabled(cfg)]
 
 
@@ -225,14 +251,16 @@ def plan_key(program=None):
     configuration — the ONE code path both Executor.run and run_steps
     key their caches on.  Covers every knob that changes what a plan
     build produces: graph-opt level, AMP mode (+ loss-scale knobs),
-    verify mode, and the sparse/dense optimizer-apply lowerings baked
-    into the traced ops."""
+    verify mode, the sparse/dense optimizer-apply lowerings baked
+    into the traced ops, and the SPMD mesh (PADDLE_TPU_MESH) the
+    sharding pass propagates and the executor pjit-lowers with."""
     from .amp import plan_key_component
+    from ..distributed._compat import mesh_key
     from ..ops.pallas.table_update import sparse_apply_mode
     from ..ops.pallas.dense_update import dense_apply_mode
     return ('pm', resolve_level(program), plan_key_component(),
             verify_mod.resolve_mode(None), sparse_apply_mode(),
-            dense_apply_mode())
+            dense_apply_mode(), mesh_key())
 
 
 # ---------------------------------------------------------------------------
@@ -249,26 +277,30 @@ _FROM_FLAG = object()
 
 def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
                  amp_mode=_FROM_FLAG, verify=_FROM_FLAG,
-                 extra_protected=(), feed_specs=None):
+                 extra_protected=(), feed_specs=None, mesh=_FROM_FLAG):
     """Run the registered pass plan over a copy of ``program``.
 
     Returns ``(program_out, report)``; the input program is never
     mutated, and with an empty plan (level 0, AMP off) the original
-    comes back untouched.  ``amp_mode``/``verify`` default to their
-    flags (PADDLE_TPU_AMP / PADDLE_TPU_VERIFY_IR); pass explicit values
-    ('0' / 'off') to pin them.  Raises IRVerificationError when the
-    verifier rejects a pass output (every_pass) or the final program
-    (boundary); a pass that *crashes* is skipped and reported instead —
-    the legacy fall-back-don't-die contract, now per pass.
+    comes back untouched.  ``amp_mode``/``verify``/``mesh`` default to
+    their flags (PADDLE_TPU_AMP / PADDLE_TPU_VERIFY_IR /
+    PADDLE_TPU_MESH); pass explicit values ('0' / 'off' / '') to pin
+    them.  Raises IRVerificationError when the verifier rejects a pass
+    output (every_pass) or the final program (boundary); a pass that
+    *crashes* is skipped and reported instead — the legacy
+    fall-back-don't-die contract, now per pass.
     """
     from .amp import resolve_mode as amp_resolve
+    from ..distributed._compat import mesh_axes_from_flag
     level = resolve_level(program, level)
     amp_mode = amp_resolve(None if amp_mode is _FROM_FLAG else amp_mode)
+    mesh_axes = mesh_axes_from_flag(
+        None if mesh is _FROM_FLAG else (mesh or ''))
     verify_mode = verify_mod.resolve_mode(
         None if verify is _FROM_FLAG else verify)
     fetch_names = tuple(fetch_names)
     feed_names = tuple(feed_names)
-    plan = build_plan(level, amp_mode)
+    plan = build_plan(level, amp_mode, mesh_axes)
 
     report = {
         'level': level,
@@ -292,7 +324,7 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
     pinned = set(extra_protected) | set(
         getattr(program, '_graph_opt_skip_set', None) or ())
     ctx = PassContext(fetch_names, feed_names, pinned, amp_mode,
-                      feed_specs=feed_specs)
+                      feed_specs=feed_specs, mesh_axes=mesh_axes)
 
     p = copy.deepcopy(program)
     passes._stamp_op_seq(p.global_block())
@@ -365,6 +397,8 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
             report['donation'] = frag['donation']
         if 'amp' in frag and frag['amp'] is not None:
             report['amp'] = frag['amp']
+        if frag.get('sharding') is not None:
+            report['sharding'] = frag['sharding']
         if frag.get('cost') is not None:
             report['cost'] = frag['cost']
         if frag.get('memory') is not None:

@@ -651,4 +651,4 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
     return pass_manager.run_pipeline(
         program, fetch_names=fetch_names, feed_names=feed_names,
         level=_resolve_level(level), amp_mode='0', verify='off',
-        extra_protected=extra_protected)
+        mesh='', extra_protected=extra_protected)

@@ -1,0 +1,246 @@
+"""Sharding-propagation pass: per-op PartitionSpecs across the plan IR.
+
+Reference parity: paddle/operators/nccl_op.cc — the Fluid core scaled
+trainers by weaving explicit ncclAllReduce ops into the graph.  The
+TPU-native answer is GSPMD: annotate the jit boundary with
+NamedShardings and XLA inserts the ICI collectives inside the ONE
+compiled train step.  This pass is the static half of that story, run
+as a REGISTERED rewrite pass (PassManager order 85, after graph-opt and
+AMP so it sees exactly the ops that will trace, ahead of the analysis
+tail — donation 90, cost 95, memory 96 — so those can consume its
+tables):
+
+- consumes the canonical role -> spec table
+  (``distributed/spec_layout.py`` — the SpecLayout pattern: activations
+  batch-shard over ``dp``, parameters + optimizer accumulators over
+  ``fsdp``, tensor-parallel heads keep the
+  ``TensorParallelTranspiler`` plan folded off ``program._tp_shard_plan``)
+  plus the mesh config (``PADDLE_TPU_MESH``, e.g. ``dp=4,tp=2`` or
+  ``fsdp=8``);
+- propagates per-op input/output shardings across the global block and
+  stamps them as hashable ``sharding_in`` / ``sharding_out`` attrs —
+  the statically checkable artifact transpiler/verify.py audits (axis
+  names must exist on the mesh, sharded dims must divide) and the
+  mutation matrix corrupts;
+- derives the **collective table**: for every (param, grad) pair of
+  each ``autodiff`` op, which ICI collective the lowering implies —
+  gradient ``allreduce`` over the batch axis for replicated params,
+  ``reduce_scatter`` + ``all_gather`` over ``fsdp`` for sharded ones —
+  with exact byte counts.  transpiler/cost_model.py prices the table
+  with the ring closed form (2(N-1)/N x bytes) and
+  transpiler/memory_model.py divides resident bytes by the shard
+  divisors;
+- publishes ``program._sharding_plan`` — mesh axes, param/feed specs,
+  per-name shard divisors, the collective table — which
+  ``core/executor.py`` turns into the ``in_shardings`` of the
+  pjit-lowered step (donated sharded state included).
+
+Programs carrying ``parallel_do`` keep their explicit shard_map path:
+the pass skips them (one distribution mechanism per program).
+"""
+from ..core import datatypes  # noqa: F401 (spec bytes go via cost_model)
+from ..distributed.spec_layout import (SpecLayout, build_param_specs,
+                                       replicated, spec_divisor)
+from . import cost_model as _cm
+
+__all__ = ['apply_sharding', 'RING_FACTORS', 'collective_ici_bytes']
+
+# closed-form ICI traffic factors, as a fraction of the payload bytes:
+# ring allreduce moves each byte out (reduce-scatter ring) and back
+# (all-gather ring) = 2(N-1)/N; its two halves are (N-1)/N each.
+RING_FACTORS = {
+    'allreduce': lambda n: 2.0 * (n - 1) / n,
+    'reduce_scatter': lambda n: (n - 1) / n,
+    'all_gather': lambda n: (n - 1) / n,
+}
+
+
+def collective_ici_bytes(kind, n, payload_bytes):
+    """Bytes one device moves over ICI for one collective of
+    ``payload_bytes`` across ``n`` participants (ring algorithm)."""
+    f = RING_FACTORS.get(kind)
+    if f is None or n <= 1:
+        return 0
+    return int(f(int(n)) * int(payload_bytes))
+
+
+def _entry_axes(entry):
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def _spec_axes(spec):
+    axes = []
+    for e in spec or ():
+        axes.extend(_entry_axes(e))
+    return axes
+
+
+def _is_sharded(spec):
+    return any(e is not None for e in (spec or ()))
+
+
+def _var_bytes(block, name, batch):
+    """Unsharded bytes of a declared var (batch-bound), 0 if unknown."""
+    spec = _cm._declared_spec(block, name, batch)
+    if spec is None:
+        return 0
+    unk = [0]
+    return _cm._spec_bytes(spec, unk)
+
+
+def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
+                   feed_specs=None):
+    """Stamp per-op shardings + the program-level plan.  Returns the
+    report fragment for ``last_graph_opt_report['sharding']``."""
+    mesh_axes = tuple(mesh_axes)
+    axes_d = dict(mesh_axes)
+    block = program.global_block()
+
+    if any(op.type == 'parallel_do'
+           for b in program.blocks for op in b.ops):
+        # parallel_do fans out through its own explicit shard_map over
+        # the ambient mesh; double-distributing would shard the shards
+        return {'mesh': mesh_axes, 'skipped': 'parallel_do'}
+
+    layout = SpecLayout(axes_d)
+    batch_axis = layout.batch_axis
+    batch_n = layout.axis_size(batch_axis) if batch_axis else 1
+    param_specs = build_param_specs(program, mesh_axes, layout)
+    batch = _cm._batch_binding(block, feed_specs)
+
+    # -- feed specs ----------------------------------------------------
+    feed_table = {}
+    names = set(feed_names) | set(feed_specs or ())
+    for n in sorted(names):
+        if feed_specs and n in feed_specs:
+            shape = tuple(int(d) for d in feed_specs[n][0])
+        else:
+            s = _cm._declared_spec(block, n, batch)
+            shape = tuple(s[0]) if s else ()
+        spec = None
+        if shape and batch_axis:
+            d0 = shape[0]
+            # concrete dim0 must equal the bound batch AND divide; a
+            # still-symbolic -1 dim0 is the batch by declaration
+            if (d0 == -1 or (batch is not None and d0 == batch)) and \
+                    (d0 == -1 or d0 % batch_n == 0):
+                spec = layout.batch(len(shape))
+        feed_table[n] = spec if spec is not None else replicated(
+            len(shape))
+
+    # -- the propagation walk ------------------------------------------
+    spec_of = dict(feed_table)
+    persist_names = set()
+    for var in program.list_vars():
+        if getattr(var, 'persistable', False) and var.shape:
+            persist_names.add(var.name)
+            spec_of[var.name] = param_specs.get(
+                var.name, replicated(len(var.shape)))
+
+    def _out_spec(name):
+        s = _cm._declared_spec(block, name, batch)
+        if s is None:
+            return None
+        shape = s[0]
+        if not shape:
+            return ()
+        if batch_axis and batch is not None and shape[0] == batch and \
+                batch % batch_n == 0:
+            return layout.batch(len(shape))
+        return replicated(len(shape))
+
+    collectives = []
+    ops_annotated = 0
+    for op in block.ops:
+        in_tab = tuple(
+            (n, spec_of.get(n)) for n in op.input_arg_names)
+        out_tab = []
+        if op.type == 'autodiff':
+            # gradients carry their parameter's sharding: GSPMD psums
+            # the batch contribution, so the visible grad matches the
+            # param layout — and that psum IS the collective table
+            for pname, gname in zip(op.attrs.get('param_names', ()),
+                                    op.attrs.get('grad_names', ())):
+                pspec = spec_of.get(pname)
+                gspec = pspec if pspec is not None else _out_spec(gname)
+                if gspec is not None:
+                    spec_of[gname] = gspec
+                out_tab.append((gname, gspec))
+                pbytes = _var_bytes(block, pname, batch)
+                gbytes = _var_bytes(block, gname, batch) or pbytes
+                fsdp_ax = layout.fsdp_axis
+                fsdp_n = layout.axis_size(fsdp_ax) if fsdp_ax else 1
+                sharded_fsdp = (fsdp_ax is not None and fsdp_n > 1 and
+                                fsdp_ax in _spec_axes(pspec))
+                if sharded_fsdp:
+                    # ZeRO: grads reduce-scatter to the shard owner,
+                    # params all-gather for the next forward
+                    collectives.append(
+                        {'name': gname, 'kind': 'reduce_scatter',
+                         'axis': fsdp_ax, 'n': fsdp_n,
+                         'bytes': gbytes})
+                    collectives.append(
+                        {'name': pname, 'kind': 'all_gather',
+                         'axis': fsdp_ax, 'n': fsdp_n,
+                         'bytes': pbytes})
+                # a data axis distinct from the shard axis still
+                # allreduces the (possibly shard-sized) grad
+                if batch_axis and batch_n > 1 and \
+                        batch_axis not in _spec_axes(pspec) and \
+                        not (sharded_fsdp and batch_axis == fsdp_ax):
+                    div = spec_divisor(pspec, axes_d)
+                    collectives.append(
+                        {'name': gname, 'kind': 'allreduce',
+                         'axis': batch_axis, 'n': batch_n,
+                         'bytes': gbytes // max(div, 1)})
+        else:
+            for n in op.output_arg_names:
+                prev = spec_of.get(n)
+                if n in persist_names:
+                    # persistable shardings are PLAN-owned: an in-place
+                    # update keeps the param-plan spec, and the batch
+                    # rule must never re-shard a weight whose dim0
+                    # merely coincides with the batch size (that would
+                    # poison the divisors the memory model divides by)
+                    out_tab.append((n, prev))
+                    continue
+                s = _out_spec(n)
+                if s is None:
+                    # declaration-less output: inherit the spec of a
+                    # same-named earlier definition, else unknown
+                    s = prev
+                elif not _is_sharded(s) and prev is not None and \
+                        len(prev) == len(s) and _is_sharded(prev):
+                    # a redefinition keeps its earlier sharded spec
+                    s = prev
+                if s is not None:
+                    spec_of[n] = s
+                out_tab.append((n, s))
+        op.attrs['sharding_in'] = in_tab
+        op.attrs['sharding_out'] = tuple(out_tab)
+        ops_annotated += 1
+
+    divisors = {n: spec_divisor(s, axes_d)
+                for n, s in spec_of.items()
+                if spec_divisor(s, axes_d) > 1}
+
+    program._sharding_plan = {
+        'mesh_axes': mesh_axes,
+        'batch_axis': batch_axis,
+        'batch': batch,
+        'params': dict(param_specs),
+        'feeds': dict(feed_table),
+        'divisors': divisors,
+        'collectives': tuple(collectives),
+    }
+
+    return {
+        'mesh': mesh_axes,
+        'batch_axis': batch_axis,
+        'params_sharded': len(param_specs),
+        'ops_annotated': ops_annotated,
+        'collectives': len(collectives),
+        'sharded_names': len(divisors),
+    }

@@ -36,6 +36,9 @@ Checks (each returns precise diagnostics, never mutates the program):
   redefinition — an op whose ``op_seq`` says it originally ran *before*
   a donated-feed write or an optimizer's in-place aliased update must
   not read that name *after* it (read-after-last-legal-use).
+- **sharding-annotation consistency** (post-sharding-propagation): every
+  ``sharding_in``/``sharding_out`` stamp and param-plan entry names only
+  axes the mesh has and splits only divisible dims.
 
 Waivers are explicit, per-op, and commented (the allowlists below) —
 the contract is fix-the-op, not loosen-the-checker.
@@ -483,6 +486,99 @@ def _check_amp(program, low_dtype, errors):
 
 
 # ---------------------------------------------------------------------------
+# sharding-annotation consistency (post-sharding-propagation programs)
+# ---------------------------------------------------------------------------
+
+def _iter_spec_axes(spec):
+    for entry in spec or ():
+        if entry is None:
+            continue
+        if isinstance(entry, tuple):
+            for a in entry:
+                yield a
+        else:
+            yield entry
+
+
+def _check_one_spec(program, where, name, spec, axes, errors):
+    """One (var, spec) annotation: axes must exist on the mesh, the
+    spec must be a per-dim tuple, and every concretely-sized sharded
+    dim must divide by the product of its axis sizes (a -1/unknown dim
+    carries no verdict)."""
+    if spec is None:
+        return  # un-propagated name: no claim, nothing to check
+    if not isinstance(spec, tuple):
+        errors.append(
+            "%s: sharding spec for %r must be a per-dim tuple, got %r"
+            % (where, name, spec))
+        return
+    for ax in _iter_spec_axes(spec):
+        if ax not in axes:
+            errors.append(
+                "%s: sharding spec for %r names axis %r, but the mesh "
+                "only has %s" % (where, name, ax, sorted(axes)))
+    try:
+        v = program.global_block().var_recursive(name)
+        shape = tuple(v.shape)
+    except KeyError:
+        return  # undeclared (grad of a temp etc.): no shape verdict
+    if getattr(v, 'lod_level', 0):
+        return  # ragged var: the staged (padded) rank adds a time dim
+    if shape and len(spec) != len(shape):
+        errors.append(
+            "%s: sharding spec for %r has %d entries but the var is "
+            "rank %d" % (where, name, len(spec), len(shape)))
+        return
+    for dim, entry in zip(shape, spec):
+        div = 1
+        for ax in _iter_spec_axes((entry,)):
+            div *= int(axes.get(ax, 1))
+        if div > 1 and dim not in (-1, None) and int(dim) % div:
+            errors.append(
+                "%s: sharding spec for %r splits a dim of size %d %d "
+                "ways — not divisible" % (where, name, int(dim), div))
+
+
+def _check_sharding(program, errors):
+    """Post-sharding-pass invariants, keyed off the plan the pass
+    stamps (``program._sharding_plan``): every ``sharding_in`` /
+    ``sharding_out`` op annotation and every param-plan entry names
+    only mesh axes and splits only divisible dims — the statically
+    checkable half of the SPMD lowering, verified like AMP's cast
+    discipline."""
+    plan = getattr(program, '_sharding_plan', None)
+    if not plan:
+        return
+    axes = dict(plan.get('mesh_axes') or ())
+    if not axes:
+        errors.append(
+            "program carries a _sharding_plan with no mesh axes — the "
+            "sharding pass stamped a plan it could not have built")
+        return
+    block = program.global_block()
+    for i, op in enumerate(block.ops):
+        for key in ('sharding_in', 'sharding_out'):
+            tab = op.attrs.get(key)
+            if tab is None:
+                continue
+            where = "%s attr %r" % (_op_str(0, i, op), key)
+            if not isinstance(tab, tuple):
+                errors.append("%s must be a tuple of (name, spec) "
+                              "pairs, got %r" % (where, type(tab)))
+                continue
+            for pair in tab:
+                if not (isinstance(pair, tuple) and len(pair) == 2):
+                    errors.append("%s carries a malformed entry %r"
+                                  % (where, pair))
+                    continue
+                _check_one_spec(program, where, pair[0], pair[1],
+                                axes, errors)
+    for name, spec in sorted((plan.get('params') or {}).items()):
+        _check_one_spec(program, "sharding plan param", name, spec,
+                        axes, errors)
+
+
+# ---------------------------------------------------------------------------
 # donation / in-place aliasing order safety
 # ---------------------------------------------------------------------------
 
@@ -592,6 +688,7 @@ def verify_program(program, fetch_names=(), feed_names=(),
         _check_infer(program, errors)
     if amp_low:
         _check_amp(program, amp_low, errors)
+    _check_sharding(program, errors)
     _check_donation_order(program, feed_names, errors)
     return errors
 

@@ -266,6 +266,15 @@ def _mut_duplicate_weaver_cast(program):
                       attrs={'out_dtype': 'bfloat16'})
 
 
+def _mut_corrupt_sharding_axis(program):
+    # rewrite one stamped annotation to name an axis the mesh lacks —
+    # the sharding-consistency check must catch and attribute it
+    for op in program.global_block().ops:
+        if op.attrs.get('sharding_out') is not None:
+            op.attrs['sharding_out'] = (('__ghost__', ('bogus',)),)
+            return
+
+
 # The verifier mutation-test matrix: every REWRITE pass registered in
 # pass_manager.PASSES must appear here (enforced statically by
 # tools/check_pass_registry.py) with a corruption the verifier catches.
@@ -275,6 +284,7 @@ PASS_MUTATIONS = {
     'cse': _mut_duplicate_op_seq,
     'dce_sweep': _mut_drop_fetch_producer,
     'amp': _mut_duplicate_weaver_cast,
+    'sharding': _mut_corrupt_sharding_axis,
 }
 
 
@@ -282,14 +292,18 @@ PASS_MUTATIONS = {
 def test_mutation_is_caught_and_attributed(pass_name, monkeypatch):
     main, fetch = _data_program()
     amp = 'bf16' if pass_name == 'amp' else '0'
+    # the sharding pass only joins the plan under a mesh config
+    mesh = 'dp=2' if pass_name == 'sharding' else ''
     # control: the uncorrupted pipeline verifies clean at every_pass
     pm.run_pipeline(main, fetch_names=(fetch,), feed_names=('x',),
-                    level=2, amp_mode=amp, verify='every_pass')
+                    level=2, amp_mode=amp, mesh=mesh,
+                    verify='every_pass')
     monkeypatch.setitem(pm._TEST_CORRUPTORS, pass_name,
                         PASS_MUTATIONS[pass_name])
     with pytest.raises(IRVerificationError) as ei:
         pm.run_pipeline(main, fetch_names=(fetch,), feed_names=('x',),
-                        level=2, amp_mode=amp, verify='every_pass')
+                        level=2, amp_mode=amp, mesh=mesh,
+                        verify='every_pass')
     assert ei.value.pass_name == pass_name
     assert ei.value.errors
 
