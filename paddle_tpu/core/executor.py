@@ -794,9 +794,11 @@ class Executor(object):
 
         if smeta is not None:
             state_rw = self._stage_state_spmd(scope, state_rw_names,
-                                              smeta['rw_sh'])
+                                              smeta['rw_sh'],
+                                              smeta.get('pads'))
             state_ro = self._stage_state_spmd(scope, state_ro_names,
-                                              smeta['ro_sh'])
+                                              smeta['ro_sh'],
+                                              smeta.get('pads'))
             rng_key = jax.device_put(self._rng_key(program),
                                      smeta['key_sh'])
         else:
@@ -919,13 +921,28 @@ class Executor(object):
         return {n: jax.device_put(v, dev) for n, v in state.items()}
 
     @staticmethod
-    def _stage_state_spmd(scope, names, shardings):
+    def _stage_state_spmd(scope, names, shardings, pads=None):
         """Stage persistable state per the plan's NamedShardings — the
         ONE staging rule all three SPMD call sites (run, run_steps,
         the prefetch path) share; steady-state re-stages are no-ops
-        via the _shard_put pass-through."""
-        return {n: _shard_put(scope.get(n), shardings[n])
-                for n in names}
+        via the _shard_put pass-through.  ``pads`` (embed plans) maps a
+        row-sharded table/accumulator to its sentinel-padded height:
+        the first stage pads the stored [V, D] value to [V_pad, D]
+        with zero rows (never gathered, never updated — the engine's
+        buckets stop at the TRUE height), after which the padded
+        buffer round-trips through the donated carry untouched."""
+        out = {}
+        for n in names:
+            v = scope.get(n)
+            padded = (pads or {}).get(n)
+            if padded and getattr(v, 'ndim', 0) >= 1 and \
+                    int(v.shape[0]) < int(padded):
+                v = jnp.asarray(v)
+                fill = jnp.zeros((int(padded) - int(v.shape[0]),)
+                                 + tuple(v.shape[1:]), v.dtype)
+                v = jnp.concatenate([v, fill])
+            out[n] = _shard_put(v, shardings[n])
+        return out
 
     def _spmd_mesh(self, program):
         """The PADDLE_TPU_MESH mesh for SPMD-lowering this program's
@@ -962,10 +979,27 @@ class Executor(object):
         from ..distributed import _compat
         plan = getattr(prog, '_sharding_plan', None) or {}
         feeds = plan.get('feeds') or {}
-        params = plan.get('params') or {}
+        params = dict(plan.get('params') or {})
+        # row-sharded embedding tables with a NON-divisible height:
+        # stage sentinel-padded to the engine's shard-divisible height
+        # (pads map state name -> padded rows).  Only when the embed
+        # lowering actually rewrote the ops — an unlowered plan (pass
+        # crash, flag off) must not feed padded tables to a plain
+        # lookup, so those names degrade to replicated staging instead
+        pads = {}
+        embed = plan.get('embed') or {}
+        for e in embed.values():
+            if int(e['padded']) == int(e['height']):
+                continue
+            for n in e.get('state', ()):
+                if plan.get('embed_lowered'):
+                    pads[n] = int(e['padded'])
+                else:
+                    params.pop(n, None)
         return {
             'mesh': mesh,
             'plan': plan,
+            'pads': pads,
             'feed_sh': {n: _compat.named_sharding(mesh, feeds.get(n))
                         for n in feed_names},
             'rw_sh': {n: _compat.named_sharding(mesh, params.get(n))
@@ -1357,9 +1391,11 @@ class Executor(object):
 
         if smeta is not None:
             state_rw = self._stage_state_spmd(scope, rw_names,
-                                              smeta['rw_sh'])
+                                              smeta['rw_sh'],
+                                              smeta.get('pads'))
             state_ro = self._stage_state_spmd(scope, ro_names,
-                                              smeta['ro_sh'])
+                                              smeta['ro_sh'],
+                                              smeta.get('pads'))
             key0 = jax.device_put(
                 jax.random.PRNGKey(self._base_seed(program)),
                 smeta['key_sh'])
@@ -1615,9 +1651,11 @@ class Executor(object):
 
         if smeta is not None:
             state_rw = self._stage_state_spmd(scope, rw_names,
-                                              smeta['rw_sh'])
+                                              smeta['rw_sh'],
+                                              smeta.get('pads'))
             state_ro = self._stage_state_spmd(scope, ro_names,
-                                              smeta['ro_sh'])
+                                              smeta['ro_sh'],
+                                              smeta.get('pads'))
             key0 = jax.device_put(
                 jax.random.PRNGKey(self._base_seed(program)),
                 smeta['key_sh'])

@@ -1,4 +1,5 @@
 from . import launch, transpiler
+from .embedding_engine import HotRowCache
 from .pipeline import PipelineTranspiler
 from .spec_layout import SpecLayout, parse_mesh_spec
 from .tensor_parallel import TensorParallel, TensorParallelTranspiler
@@ -7,4 +8,4 @@ from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
 __all__ = ['transpiler', 'launch', 'DistributeTranspiler',
            'SimpleDistributeTranspiler', 'PipelineTranspiler',
            'TensorParallelTranspiler', 'TensorParallel',
-           'SpecLayout', 'parse_mesh_spec']
+           'SpecLayout', 'parse_mesh_spec', 'HotRowCache']

@@ -1,0 +1,502 @@
+"""Sharded embedding engine: mesh-partitioned tables, all-to-all lookup,
+per-shard row-sparse apply, hot-row cache.
+
+Reference parity: the Fluid ``distribute_transpiler`` scaled giant CTR
+tables by splitting them across parameter servers and rewriting every
+lookup into a ``split_ids -> prefetch(pserver RPC) -> merge`` chain
+(operators/lookup_table_op + distributed/parameter_prefetch).  The
+TPU-native answer keeps the table on the accelerators themselves:
+row-shard it over the mesh (``SpecLayout.embeddings``: rows over
+``(fsdp, tp)``) and turn the RPC chain into ICI collectives —
+
+    lookup  =  all-to-all of ids -> per-shard LOCAL gather -> all-to-all
+               of rows back
+    apply   =  bucket the SelectedRows grad by shard -> per-shard Pallas
+               row-walk (ops/pallas/table_update.py) on LOCAL rows only,
+               donated, in place
+
+Everything here is expressed as static-shape jax the executor traces
+into the one compiled step; under ``PADDLE_TPU_MESH`` + GSPMD the
+bucket/gather/reassemble structure lowers to exactly the two all-to-alls
+the cost model prices (``(N-1)/N x bytes`` per direction).  The ragged
+per-shard id buckets reuse the PR-4 sentinel-row contract verbatim: each
+shard's bucket is padded to one tile-aligned capacity
+(``PADDLE_TPU_EMBED_BUCKET_TILE``) with the shard's LOCAL height as the
+sentinel, which both the Pallas kernel (skip) and the XLA scatter oracle
+(out-of-bounds drop) treat as an exact no-op — so ragged bucket fills
+are bitwise-invisible, the same trick that made ragged touched-row
+counts bucketable in PR 4.
+
+Non-divisible vocab heights pad the TABLE, not the math: the height is
+rounded up to the next shard-divisible multiple with sentinel rows that
+are never gathered (ids stay ``< height``) and never updated (grad rows
+stay ``< height``); ``padding_idx`` resolves against the TRUE height, so
+its semantics are preserved bitwise.
+
+On top sits the **hot-row cache** (``HotRowCache``): a small replicated
+copy of the top-K most frequent rows — Criteo id traffic is heavily
+skewed, so a cache of 1e3 rows absorbs most of a 1e6-row table's lookups
+— served locally so the common case never crosses the interconnect.
+Coherence is write-through: after an apply touches rows, the cached
+copies refresh from the updated table; admission re-ranks by observed
+frequency and EVICTS (invalidates) displaced rows.  Hit/miss/evict
+counters land in the observability registry
+(``paddle_tpu_embed_cache_{hits,misses,evictions}_total``).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ['pad_height', 'bucket_cap', 'bucket_ids', 'bucket_rows',
+           'sharded_lookup', 'sharded_apply_sgd', 'sharded_apply_adagrad',
+           'sharded_apply_adam', 'shard_slices', 'HotRowCache']
+
+
+def pad_height(height, ways):
+    """The next ``ways``-divisible height >= ``height`` — the sentinel-
+    padded table height a ``ways``-way row shard stores.  The pad rows
+    are never gathered (ids < height) and never updated (grad rows <
+    height), so ``padded - height < ways`` dead rows per table is the
+    whole cost of a non-divisible vocab."""
+    height, ways = int(height), int(ways)
+    if ways <= 1:
+        return height
+    return -(-height // ways) * ways
+
+
+def bucket_cap(n_ids, tile):
+    """Per-shard bucket capacity for ``n_ids`` ids: every shard's bucket
+    is padded to ONE tile-aligned size (worst case: all ids land on one
+    shard), so the bucketed layout compiles one shape per batch size
+    instead of one per id distribution."""
+    tile = max(int(tile), 1)
+    return max(-(-max(int(n_ids), 1) // tile) * tile, tile)
+
+
+def _shard_of(ids, local_h, height, ways):
+    """(shard, local) for each id, with anything outside [0, height)
+    mapped to (0, local_h) — the per-shard sentinel both consumers
+    skip.  This is what makes the AMP skip-step contract compose: a
+    gated SelectedRows swaps its rows to >= height, and the swap lands
+    every slot on a sentinel in every shard."""
+    valid = (ids >= 0) & (ids < height)
+    shard = jnp.where(valid, ids // local_h, 0)
+    local = jnp.where(valid, ids - shard * local_h, local_h)
+    return shard.astype(jnp.int32), local.astype(jnp.int32)
+
+
+def bucket_ids(ids, height, ways, tile=8, padded=None):
+    """The all-to-all send layout for one id vector.
+
+    ``ids`` [N] int32 global row ids -> ``(buckets, back)`` where
+    ``buckets`` is [ways, cap] of LOCAL row ids (shard s's bucket holds
+    the ids it owns, rebased to ``[0, local_h)``; unused slots carry the
+    sentinel ``local_h``) and ``back`` is [N] flat indices into the
+    [ways * cap] gathered-row buffer that reassemble the original order
+    — the return all-to-all.  Stable within each bucket: duplicates of
+    one row keep their original slot order, which is what lets the
+    per-shard SGD accumulate bitwise like the global scatter."""
+    ids = ids.astype(jnp.int32).reshape(-1)
+    height = int(height)
+    padded = int(padded) if padded else pad_height(height, ways)
+    local_h = padded // int(ways)
+    n = int(ids.shape[0])
+    cap = bucket_cap(n, tile)
+    if n == 0:
+        return (jnp.full((int(ways), cap), local_h, jnp.int32),
+                jnp.zeros((0,), jnp.int32))
+    shard, local = _shard_of(ids, local_h, height, ways)
+    order = jnp.argsort(shard, stable=True)
+    sid = shard[order]
+    ones = jnp.ones((n,), jnp.int32)
+    counts = jax.ops.segment_sum(ones, shard, num_segments=int(ways))
+    offsets = jnp.cumsum(counts) - counts  # exclusive prefix per shard
+    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sid]
+    buckets = jnp.full((int(ways), cap), local_h, jnp.int32)
+    buckets = buckets.at[sid, pos].set(local[order])
+    back = jnp.zeros((n,), jnp.int32).at[order].set(sid * cap + pos)
+    return buckets, back
+
+
+def bucket_rows(rows, values, height, ways, tile=8, padded=None):
+    """The apply-path counterpart of :func:`bucket_ids`: route a
+    SelectedRows grad's ``(rows [K], values [K, D])`` into per-shard
+    buckets ``(local_rows [ways, cap], local_vals [ways, cap, D])`` —
+    shard s's slice of the grad, rows rebased local, ragged fill padded
+    with the sentinel ``local_h`` (fill slots carry zero values;
+    invalid input rows keep their values on sentinel slots, which both
+    consumers skip by row id — same note as merge_rows_sentinel).
+    Slot order within a shard is the original slot order (stable), so
+    duplicate-row accumulation is bitwise the global kernel's."""
+    rows = rows.astype(jnp.int32).reshape(-1)
+    height = int(height)
+    padded = int(padded) if padded else pad_height(height, ways)
+    local_h = padded // int(ways)
+    k = int(rows.shape[0])
+    cap = bucket_cap(k, tile)
+    width = values.shape[1:]
+    if k == 0:
+        return (jnp.full((int(ways), cap), local_h, jnp.int32),
+                jnp.zeros((int(ways), cap) + width, values.dtype))
+    shard, local = _shard_of(rows, local_h, height, ways)
+    order = jnp.argsort(shard, stable=True)
+    sid = shard[order]
+    ones = jnp.ones((k,), jnp.int32)
+    counts = jax.ops.segment_sum(ones, shard, num_segments=int(ways))
+    offsets = jnp.cumsum(counts) - counts
+    pos = jnp.arange(k, dtype=jnp.int32) - offsets[sid]
+    local_rows = jnp.full((int(ways), cap), local_h, jnp.int32)
+    local_rows = local_rows.at[sid, pos].set(local[order])
+    local_vals = jnp.zeros((int(ways), cap) + width, values.dtype)
+    local_vals = local_vals.at[sid, pos].set(values[order])
+    return local_rows, local_vals
+
+
+def shard_slices(table, ways, padded=None):
+    """The ``ways`` local [local_h, D] row slices of a (padded) table —
+    static-bound ``lax.slice_in_dim`` views, so under GSPMD each slice
+    is exactly one device's resident rows and the per-shard kernel
+    below never reaches across the interconnect."""
+    padded = int(padded) if padded else table.shape[0]
+    local_h = padded // int(ways)
+    return [jax.lax.slice_in_dim(table, s * local_h, (s + 1) * local_h)
+            for s in range(int(ways))]
+
+
+def _ensure_padded(table, padded):
+    """Functionally sentinel-pad an unpadded table (the executor pads
+    persistable state once at staging; this covers eager/test callers
+    and traced programs whose state was staged by an older plan)."""
+    padded = int(padded)
+    if int(table.shape[0]) >= padded:
+        return table
+    fill = jnp.zeros((padded - int(table.shape[0]),) + table.shape[1:],
+                     table.dtype)
+    return jnp.concatenate([table, fill])
+
+
+# ---------------------------------------------------------------------------
+# forward: all-to-all of ids -> per-shard local gather -> all-to-all back
+# ---------------------------------------------------------------------------
+
+def sharded_lookup(w, ids, ways, height=None, tile=8, padding_idx=None,
+                   cache_rows=None, cache_vals=None):
+    """Row-sharded ``lookup_table`` forward.
+
+    Bitwise-identical to ``jnp.take(w[:height], ids, axis=0)`` (plus the
+    ``padding_idx`` zero-mask, resolved against the TRUE height): the
+    gathered values are exact row copies, only the route changes —
+    ids bucket per owning shard (tile-aligned, sentinel-filled), each
+    shard gathers its LOCAL rows, and the row buckets reassemble in
+    original id order.  Under GSPMD with ``w`` row-sharded, the bucket
+    scatter and the reassembly ARE the two all-to-alls.
+
+    With ``cache_rows``/``cache_vals`` (a :class:`HotRowCache` state;
+    ``cache_rows`` must be SORTED ascending with the ``height``
+    sentinel filling empty slots — HotRowCache maintains exactly
+    this), ids present in the cache are served from the replicated
+    copy and masked OUT of the sharded route (their bucket slots
+    become sentinels), so cache hits move zero interconnect bytes.
+    Membership is one ``searchsorted`` over the sorted row set —
+    O(N log C), never an [N, C] equality matrix.  Returns
+    ``(values, hits)`` in that case (``hits`` = scalar hit count for
+    the caller's counters); plain ``values`` otherwise."""
+    ways = int(ways)
+    height = int(height) if height is not None else int(w.shape[0])
+    padded = pad_height(height, ways)
+    w = _ensure_padded(w, padded)
+    local_h = padded // ways
+    width = w.shape[1]
+    ids_shape = ids.shape
+    flat = ids.astype(jnp.int32).reshape(-1)
+    # jnp.take clamps out-of-range ids (XLA gather clip mode); the
+    # sharded route must resolve ids the same way before bucketing
+    flat = jnp.clip(flat, 0, height - 1)
+
+    n_hits = None
+    hit = cpos = None
+    route = flat
+    if cache_rows is not None and cache_vals is not None and \
+            int(cache_rows.shape[0]) > 0:
+        c = int(cache_rows.shape[0])
+        cpos = jnp.minimum(jnp.searchsorted(cache_rows, flat),
+                           c - 1).astype(jnp.int32)
+        # sentinel slots hold `height` and flat < height, so an empty
+        # slot can never compare equal
+        hit = cache_rows[cpos] == flat
+        n_hits = jnp.sum(hit.astype(jnp.int32))
+        # hits leave the sharded route: their slots turn into sentinels
+        # (>= height -> per-shard sentinel in _shard_of), so the
+        # all-to-all payload shrinks to the miss set
+        route = jnp.where(hit, height, flat)
+
+    buckets, back = bucket_ids(route, height, ways, tile=tile,
+                               padded=padded)
+    tables = w.reshape(ways, local_h, width)
+    safe = jnp.minimum(buckets, local_h - 1)
+    gathered = jnp.take_along_axis(tables, safe[..., None], axis=1)
+    y = gathered.reshape(-1, width)[back]
+
+    if hit is not None:
+        y = jnp.where(hit[:, None], cache_vals[cpos], y)
+
+    y = y.reshape(ids_shape + (width,))
+    if padding_idx is not None:
+        pad = int(padding_idx)
+        if pad < 0:  # fluid convention resolves against the TRUE height
+            pad = height + pad
+        mask = (ids.astype(jnp.int32) != pad)[..., None]
+        y = jnp.where(mask, y, jnp.zeros_like(y))
+    if n_hits is not None:
+        return y, n_hits
+    return y
+
+
+# ---------------------------------------------------------------------------
+# backward/apply: per-shard Pallas row-walk on LOCAL rows only
+# ---------------------------------------------------------------------------
+
+def _per_shard(tables, rows, values, height, ways, tile, padded, apply):
+    """Drive ``apply(shard_tables, local_rows, local_vals) -> updated
+    shard tables`` over every shard and reassemble.  ``tables`` is a
+    list of [H, D] state tables (param + moments) updated together;
+    each shard sees only its LOCAL [local_h, D] slices and LOCAL row
+    ids — the verifier's "sharded apply addresses local row ranges
+    only" claim is true by construction here, not by convention."""
+    padded = int(padded) if padded else pad_height(height, ways)
+    tables = [_ensure_padded(t, padded) for t in tables]
+    local_rows, local_vals = bucket_rows(rows, values, height, ways,
+                                         tile=tile, padded=padded)
+    slices = [shard_slices(t, ways, padded) for t in tables]
+    outs = [[] for _ in tables]
+    for s in range(int(ways)):
+        upd = apply([sl[s] for sl in slices], local_rows[s],
+                    local_vals[s])
+        if not isinstance(upd, (list, tuple)):
+            upd = (upd,)
+        for o, u in zip(outs, upd):
+            o.append(u)
+    return tuple(jnp.concatenate(o) for o in outs)
+
+
+def sharded_apply_sgd(param, rows, values, lr, ways, height=None,
+                      tile=8, interpret=None):
+    """Row-sharded sparse SGD: each shard's slice of the SelectedRows
+    grad runs the PR-4 Pallas row-walk (``sparse_apply_sgd``) on its
+    LOCAL rows, donated in place.  Bitwise the single-device kernel
+    (and therefore the XLA scatter): per-row slot order is preserved
+    by the stable bucketing, and shards touch disjoint rows."""
+    from ..ops.pallas.table_update import sparse_apply_sgd
+    height = int(height) if height is not None else int(param.shape[0])
+    (p_new,) = _per_shard(
+        [param], rows, values, height, ways, tile, None,
+        lambda tabs, r, v: sparse_apply_sgd(tabs[0], r, v, lr,
+                                            interpret=interpret))
+    return p_new
+
+
+def sharded_apply_adagrad(param, moment, rows, values, lr, epsilon,
+                          ways, height=None, tile=8, interpret=None):
+    """Row-sharded fused sparse Adagrad (param + moment, one pass per
+    shard, local rows only).  Returns ``(param_new, moment_new)``."""
+    from ..ops.pallas.table_update import sparse_apply_adagrad
+    height = int(height) if height is not None else int(param.shape[0])
+    return _per_shard(
+        [param, moment], rows, values, height, ways, tile, None,
+        lambda tabs, r, v: sparse_apply_adagrad(
+            tabs[0], tabs[1], r, v, lr, epsilon, interpret=interpret))
+
+
+def sharded_apply_adam(param, moment1, moment2, rows, values, lr_t,
+                       beta1, beta2, epsilon, ways, height=None, tile=8,
+                       interpret=None):
+    """Row-sharded fused lazy sparse Adam (param + both moments, one
+    pass per shard, local rows only — sentinel slots decay nothing).
+    Returns ``(param_new, m1_new, m2_new)``."""
+    from ..ops.pallas.table_update import sparse_apply_adam
+    height = int(height) if height is not None else int(param.shape[0])
+    return _per_shard(
+        [param, moment1, moment2], rows, values, height, ways, tile,
+        None,
+        lambda tabs, r, v: sparse_apply_adam(
+            tabs[0], tabs[1], tabs[2], r, v, lr_t, beta1, beta2,
+            epsilon, interpret=interpret))
+
+
+# ---------------------------------------------------------------------------
+# hot-row cache
+# ---------------------------------------------------------------------------
+
+class _CacheMetrics(object):
+    """Registry handles, allocated on first enabled use (the PR-2
+    zero-cost-when-disabled contract)."""
+
+    def __init__(self):
+        r = _obs.registry()
+        self.hits = r.counter(
+            'paddle_tpu_embed_cache_hits_total',
+            'embedding lookups served from the replicated hot-row '
+            'cache (no interconnect crossing)').child()
+        self.misses = r.counter(
+            'paddle_tpu_embed_cache_misses_total',
+            'embedding lookups that missed the hot-row cache and took '
+            'the sharded all-to-all route').child()
+        self.evictions = r.counter(
+            'paddle_tpu_embed_cache_evictions_total',
+            'hot-row cache rows displaced (invalidated) by admission '
+            're-ranking').child()
+
+
+_cache_metrics = None
+
+
+def _cm():
+    global _cache_metrics
+    if _cache_metrics is None:
+        _cache_metrics = _CacheMetrics()
+    return _cache_metrics
+
+
+class HotRowCache(object):
+    """Replicated cache of the top-K most frequent embedding rows.
+
+    State is two device arrays — ``rows`` [C] int32 (``height`` =
+    empty-slot sentinel) and ``vals`` [C, D] — small enough to
+    replicate on every device, so a hit is a local read.  The policy
+    half runs on the host:
+
+    - ``observe(ids)`` folds a batch's ids into the frequency ranking
+      (exact counts via ``np.unique`` — the id vectors are batch-sized,
+      not table-sized).
+    - ``admit(lookup_fn)`` re-ranks: the top-C observed rows become the
+      cache set, displaced rows are EVICTED (counted + invalidated —
+      their slots are overwritten, so a stale read is impossible), and
+      the new set's values load through ``lookup_fn`` (one sharded
+      gather).
+    - ``write_through(rows, table)`` keeps hits coherent with training:
+      after an apply touched ``rows``, every touched row present in the
+      cache refreshes from the UPDATED table — update-then-lookup
+      through the cache is bitwise the uncached lookup.
+
+    ``lookup(table, ids, ...)`` routes through
+    :func:`sharded_lookup`'s cache arguments and accumulates
+    hit/miss counters (host-side, read from the returned hit count).
+    """
+
+    def __init__(self, capacity, height, width, ways=1, tile=8,
+                 dtype=jnp.float32):
+        self.capacity = int(capacity)
+        self.height = int(height)
+        self.width = int(width)
+        self.ways = int(ways)
+        self.tile = int(tile)
+        self.rows = jnp.full((self.capacity,), self.height, jnp.int32)
+        self.vals = jnp.zeros((self.capacity, self.width), dtype)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._freq = {}
+
+    # -- policy (host) --------------------------------------------------
+
+    def observe(self, ids):
+        u, c = np.unique(np.asarray(ids).reshape(-1), return_counts=True)
+        for i, n in zip(u.tolist(), c.tolist()):
+            if 0 <= i < self.height:
+                self._freq[i] = self._freq.get(i, 0) + n
+
+    def top_rows(self):
+        """The current top-C observed rows (host ranking)."""
+        ranked = sorted(self._freq.items(), key=lambda kv: (-kv[1],
+                                                            kv[0]))
+        return [r for r, _n in ranked[:self.capacity]]
+
+    def admit(self, table):
+        """Re-rank and reload: cache the top-C observed rows, evicting
+        (invalidating) whatever the new set displaces.  ``table`` is
+        the CURRENT [H, D] table (or a ``lookup(ids) -> [n, D]``
+        callable) the admitted values load from."""
+        new = self.top_rows()
+        old = set(int(r) for r in np.asarray(self.rows).tolist()
+                  if 0 <= int(r) < self.height)
+        evicted = old - set(new)
+        if evicted:
+            self.evictions += len(evicted)
+            if _obs.enabled():
+                _cm().evictions.inc(len(evicted))
+        rows = np.full((self.capacity,), self.height, np.int32)
+        # stored SORTED (sentinels sort to the tail naturally): the
+        # read path's membership test is one searchsorted
+        rows[:len(new)] = np.sort(np.asarray(new, np.int32))
+        self.rows = jnp.asarray(rows)
+        vals = np.zeros((self.capacity, self.width),
+                        np.asarray(self.vals).dtype)
+        if new:
+            fetch = jnp.asarray(rows[:len(new)])
+            if callable(table):
+                got = table(fetch)
+            else:
+                got = sharded_lookup(table, fetch, self.ways,
+                                     height=self.height, tile=self.tile)
+            vals[:len(new)] = np.asarray(got)
+        self.vals = jnp.asarray(vals)
+        return len(new), len(evicted)
+
+    # -- coherence ------------------------------------------------------
+
+    def write_through(self, touched_rows, table):
+        """Refresh cached copies of rows an apply just touched, from
+        the UPDATED table — the write-through half of coherence.  Rows
+        not in the cache are ignored; cache slots not touched keep
+        their values (still coherent: the apply didn't move them)."""
+        touched = jnp.asarray(touched_rows).astype(jnp.int32).reshape(-1)
+        if int(touched.shape[0]) == 0 or self.capacity == 0:
+            return
+        ts = jnp.sort(touched)
+        pos = jnp.minimum(jnp.searchsorted(ts, self.rows),
+                          int(ts.shape[0]) - 1)
+        in_cache = (ts[pos] == self.rows) & (self.rows < self.height)
+        safe = jnp.minimum(self.rows, self.height - 1)
+        if callable(table):
+            fresh = table(safe)
+        else:
+            fresh = sharded_lookup(table, safe, self.ways,
+                                   height=self.height, tile=self.tile)
+        self.vals = jnp.where(in_cache[:, None], fresh, self.vals)
+
+    # -- the read path --------------------------------------------------
+
+    def lookup(self, table, ids, padding_idx=None, observe=True):
+        """Cached sharded lookup: hits serve from the replicated copy,
+        misses take the all-to-all route; bitwise the uncached lookup
+        as long as coherence held (write_through after every apply)."""
+        if observe:
+            self.observe(ids)
+        y, n_hits = sharded_lookup(
+            table, ids, self.ways, height=self.height, tile=self.tile,
+            padding_idx=padding_idx, cache_rows=self.rows,
+            cache_vals=self.vals)
+        h = int(n_hits)
+        m = int(np.prod(np.asarray(ids).shape)) - h
+        self.hits += h
+        self.misses += m
+        if _obs.enabled():
+            cm = _cm()
+            if h:
+                cm.hits.inc(h)
+            if m:
+                cm.misses.inc(m)
+        return y
+
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return (self.hits / total) if total else 0.0
+
+    def stats(self):
+        return {'hits': self.hits, 'misses': self.misses,
+                'evictions': self.evictions,
+                'hit_rate': self.hit_rate(),
+                'resident_rows': int(np.sum(
+                    np.asarray(self.rows) < self.height))}

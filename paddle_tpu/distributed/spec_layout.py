@@ -139,11 +139,17 @@ class SpecLayout(object):
     """
 
     def __init__(self, axes, data_axis='dp', fsdp_axis='fsdp',
-                 tp_axis='tp'):
+                 tp_axis='tp', embed_pad=True):
         self.axes = dict(axes)
         self.data_axis = data_axis if data_axis in self.axes else None
         self.fsdp_axis = fsdp_axis if fsdp_axis in self.axes else None
         self.tp_axis = tp_axis if tp_axis in self.axes else None
+        # embed_pad: row-shard lookup tables whose height does NOT
+        # divide, relying on the embedding engine's sentinel-row
+        # padding (distributed/embedding_engine.pad_height).  The
+        # sharding pass pins it to the PADDLE_TPU_EMBED_SHARD mode so
+        # an un-padded consumer never sees an indivisible split.
+        self.embed_pad = bool(embed_pad)
 
     @property
     def batch_axis(self):
@@ -185,15 +191,31 @@ class SpecLayout(object):
                 return tuple(spec)
         return None
 
-    def embeddings(self, shape):
-        """Embedding tables: rows over (fsdp, tp) — SNIPPETS.md [1]
-        ``embeddings(): PS((fsdp, tp), None)`` — when both axes exist
-        and divide; falls back to the plain param rule otherwise."""
-        both = tuple(a for a in (self.fsdp_axis, self.tp_axis) if a)
-        if len(both) == 2 and shape:
-            div = self.axis_size(both[0]) * self.axis_size(both[1])
-            if int(shape[0]) % div == 0 and int(shape[0]) >= 2 * div:
-                return (both,) + (None,) * (len(shape) - 1)
+    def embeddings(self, shape, allow_pad=True):
+        """Embedding tables: ROWS over the model-state axes — SNIPPETS
+        [1] ``embeddings(): PS((fsdp, tp), None)`` when both exist,
+        degrading to whichever of fsdp/tp the mesh has (a lookup
+        table's natural split is its vocab dim: row ownership is what
+        makes the all-to-all lookup and the per-shard apply local).
+        Non-divisible heights still row-shard when ``embed_pad`` AND
+        ``allow_pad`` hold (the engine sentinel-pads the table to the
+        next divisible height; callers clear ``allow_pad`` for tables
+        with DENSE-grad lookups, whose [V, D] grad would carry the
+        indivisible split the verifier rightly rejects); otherwise —
+        and for heights too small to matter — falls back to the plain
+        param rule."""
+        row_axes = tuple(a for a in (self.fsdp_axis, self.tp_axis)
+                         if a)
+        if row_axes and shape:
+            div = 1
+            for a in row_axes:
+                div *= self.axis_size(a)
+            height = int(shape[0])
+            if div > 1 and height >= 2 * div and \
+                    (height % div == 0 or
+                     (self.embed_pad and allow_pad)):
+                entry = row_axes if len(row_axes) > 1 else row_axes[0]
+                return (entry,) + (None,) * (len(shape) - 1)
         return self.param(shape)
 
 
@@ -206,12 +228,20 @@ def build_param_specs(program, axes, layout=None):
     axes_d = layout.axes
     plan = {}
     tp_plan = getattr(program, '_tp_shard_plan', None) or {}
-    emb_names = _embedding_param_names(program)
+    emb_tables = _embedding_tables(program)
+    emb_names = set(emb_tables)
     for var in program.list_vars():
         if not getattr(var, 'persistable', False) or not var.shape:
             continue
         if any(int(d) < 0 for d in var.shape):
             continue  # batch-shaped persistable: not a parameter
+        if _accumulator_of(var.name, emb_names):
+            # an embedding table's optimizer accumulator must follow
+            # the TABLE's row spec (extend_to_accumulators copies it
+            # below), never the generic param rule — a moment sharded
+            # on D under a row-sharded table could not be sliced in
+            # lockstep by the per-shard apply
+            continue
         spec = None
         if var.name in tp_plan:
             spec = normalize_spec(tp_plan[var.name], len(var.shape),
@@ -219,7 +249,8 @@ def build_param_specs(program, axes, layout=None):
             if not any(e is not None for e in spec):
                 spec = None  # degraded entirely: fall to the fsdp rule
         if spec is None and var.name in emb_names:
-            spec = layout.embeddings(var.shape)
+            spec = layout.embeddings(var.shape,
+                                     allow_pad=emb_tables[var.name])
         if spec is None:
             spec = layout.param(var.shape)
         if spec is not None:
@@ -227,18 +258,39 @@ def build_param_specs(program, axes, layout=None):
     return extend_to_accumulators(program, plan)
 
 
+def _accumulator_of(name, param_names):
+    """True when ``name`` is an optimizer-accumulator var of one of
+    ``param_names`` (the ``<param>_<stem>_<n>`` naming rule)."""
+    for pname in param_names:
+        if name.startswith(pname + '_') and \
+                ACC_SUFFIX.fullmatch(name[len(pname) + 1:]):
+            return True
+    return False
+
+
 def _embedding_param_names(program):
     """Names of lookup-table weights — the params the ``embeddings``
     role ((fsdp, tp) row split) applies to when no explicit tp plan
     claims them."""
-    names = set()
+    return set(_embedding_tables(program))
+
+
+def _embedding_tables(program):
+    """{lookup-table weight name: every lookup of it is sparse-grad}.
+    The bool gates sentinel-padding: a dense-grad lookup (the
+    layers.embedding default) autodiffs to a full [V, D] grad that
+    would carry the table's indivisible row split — only tables whose
+    grads stay SelectedRows (routed through the per-shard apply) may
+    pad a non-divisible height."""
+    tables = {}
     for block in program.blocks:
         for op in block.ops:
             if op.type != 'lookup_table':
                 continue
-            w = op.inputs.get('W') or ()
-            names.update(w)
-    return names
+            sparse = bool(op.attrs.get('is_sparse', False))
+            for w in op.inputs.get('W') or ():
+                tables[w] = tables.get(w, True) and sparse
+    return tables
 
 
 def extend_to_accumulators(program, plan):

@@ -328,6 +328,37 @@ DEFINE_float('ici_gbps', 0.0,
              'count the ring-allreduce closed form produces either '
              'way.  0 (default) reports bytes only — no fake seconds '
              'on hardware whose interconnect was never measured')
+DEFINE_string('embed_shard', 'auto',
+              'sharded embedding engine '
+              '(distributed/embedding_engine.py) under PADDLE_TPU_MESH:'
+              ' "auto"/"on" (default) row-shards every lookup_table '
+              'weight over the mesh\'s model axes (fsdp/tp, SNIPPETS '
+              'SpecLayout embeddings role) and lowers its lookup to '
+              'all-to-all of ids -> per-shard local gather -> '
+              'all-to-all of rows back, with the sparse optimizer '
+              'apply routed per shard onto local rows only; '
+              'non-divisible vocab heights sentinel-pad to the next '
+              'shard-divisible height (padding_idx semantics preserved '
+              'bitwise).  "off" keeps the pre-engine behavior (tables '
+              'follow the generic fsdp param rule, lookups stay '
+              'single-route).  Without a mesh the flag is inert.  '
+              'Re-read per plan build and part of the composite '
+              'plan-cache key, so flips take effect without a restart')
+DEFINE_int('embed_bucket_tile', 8,
+           'tile alignment for the sharded-embedding engine\'s '
+           'per-shard id buckets: each shard\'s bucket pads to a '
+           'multiple of this many slots with PR-4-style sentinel rows '
+           '(skipped by the Pallas apply, dropped by the XLA oracle), '
+           'so ragged per-shard id counts compile one bucket shape per '
+           'batch size.  Part of the composite plan-cache key')
+DEFINE_int('embed_cache_rows', 0,
+           'capacity of the hot-row embedding cache '
+           '(distributed/embedding_engine.HotRowCache) benches and '
+           'serving paths construct for frequency-skewed id traffic: '
+           'the top-K observed rows replicate on every device and '
+           'serve lookups locally (write-through coherent, eviction '
+           'invalidates), so the common case moves zero interconnect '
+           'bytes.  0 (default) builds no cache')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -92,7 +92,11 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     w = helper.create_parameter(
         attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False)
     tmp = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
-    attrs = {'is_sparse': is_sparse}
+    # declared vocab height rides the op: the kernel must resolve a
+    # negative padding_idx against the TRUE height even when the staged
+    # table carries sentinel pad rows past it (sharded-embedding plans
+    # leave the padded [V_pad, D] buffer in the scope)
+    attrs = {'is_sparse': is_sparse, 'height': int(size[0])}
     if padding_idx is not None:
         attrs['padding_idx'] = padding_idx
     helper.append_op(

@@ -23,11 +23,28 @@ def _lookup_table(ctx, ins, attrs):
     squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
     if squeeze_last:
         ids = ids.squeeze(-1)
+    ways = int(attrs.get('embed_ways') or 0)
+    if ways > 1 and w.ndim == 2:
+        # row-sharded table (stamped by transpiler/sharding.py's
+        # embed_shard pass): all-to-all of ids -> per-shard LOCAL
+        # gather -> all-to-all of rows back.  Bitwise the jnp.take
+        # below, incl. padding_idx against the TRUE height (the stored
+        # table may carry sentinel pad rows past it)
+        from ..distributed.embedding_engine import sharded_lookup
+        y = sharded_lookup(
+            w, ids, ways, height=int(attrs['embed_height']),
+            tile=int(attrs.get('embed_tile', 8)),
+            padding_idx=attrs.get('padding_idx', None))
+        return out(y)
     y = jnp.take(w, ids, axis=0)
     pad = attrs.get('padding_idx', None)
     if pad is not None:
-        if pad < 0:  # fluid convention: -1 means row vocab_size-1
-            pad = w.shape[0] + pad
+        if pad < 0:  # fluid convention: -1 means row vocab_size-1,
+            # resolved against the DECLARED height (the staged table
+            # may carry sentinel pad rows past it after a sharded
+            # plan ran); w.shape[0] is the legacy fallback for
+            # hand-built OpDescs without the height attr
+            pad = int(attrs.get('height', w.shape[0])) + pad
         mask = (ids != pad)[..., None]
         y = jnp.where(mask, y, jnp.zeros_like(y))
     return out(y)

@@ -63,6 +63,20 @@ def _pallas_rowwise(p, values):
     return sparse_apply_mode() == 'pallas'
 
 
+def _embed_ways(attrs, p, values):
+    """Shard count when this sparse apply targets a row-sharded
+    embedding table (attrs stamped by the embed_shard pass) AND the
+    Pallas row-walk serves it — the engine routes each shard's
+    SelectedRows slice onto the kernel over LOCAL rows only.  Under
+    PADDLE_TPU_SPARSE_APPLY=xla the global scatter stays (rows < true
+    height never touch the sentinel pad rows, so it is equally
+    correct, just not shard-local)."""
+    ways = int(attrs.get('embed_ways') or 0)
+    if ways > 1 and _pallas_rowwise(p, values):
+        return ways
+    return 0
+
+
 def _pallas_dense(p, g):
     """True when the fused flat-walk kernel should serve this dense
     update: mode resolves to pallas and grad/param agree in shape (the
@@ -112,6 +126,14 @@ def _sgd(ctx, ins, attrs):
     if sp is not None:
         # row-wise apply: duplicates accumulate (linear update)
         rows, values = sp
+        ways = _embed_ways(attrs, p, values)
+        if ways:
+            from ..distributed.embedding_engine import sharded_apply_sgd
+            p_new = sharded_apply_sgd(
+                _p32(p), rows, _p32(values), lr, ways,
+                height=int(attrs['embed_height']),
+                tile=int(attrs.get('embed_tile', 8)))
+            return {'ParamOut': [p_new.astype(p.dtype)]}
         if _pallas_rowwise(p, values):
             from .pallas.table_update import sparse_apply_sgd
             p_new = sparse_apply_sgd(_p32(p), rows, _p32(values), lr)
@@ -175,6 +197,16 @@ def _adam(ctx, ins, attrs):
         # lazy sparse adam: moments decay and the param moves only on
         # touched rows; duplicate rows merge first (nonlinear update)
         rows, values = sp
+        ways = _embed_ways(attrs, p, values)
+        if ways:
+            from ..distributed.embedding_engine import \
+                sharded_apply_adam
+            p_new, m_new, v_new = sharded_apply_adam(
+                _p32(p), m, v, rows, _p32(values), lr_t, b1, b2, eps,
+                ways, height=int(attrs['embed_height']),
+                tile=int(attrs.get('embed_tile', 8)))
+            return {'ParamOut': [p_new.astype(p.dtype)],
+                    'Moment1Out': [m_new], 'Moment2Out': [v_new]}
         if _pallas_rowwise(p, values):
             from .pallas.table_update import sparse_apply_adam
             p_new, m_new, v_new = sparse_apply_adam(
@@ -235,6 +267,16 @@ def _adagrad(ctx, ins, attrs):
         # reference adagrad_op.cc sparse branch: merge duplicate rows,
         # then accumulate + step on the touched rows only
         rows, values = sp
+        ways = _embed_ways(attrs, p, values)
+        if ways:
+            from ..distributed.embedding_engine import \
+                sharded_apply_adagrad
+            p_new, mom_new = sharded_apply_adagrad(
+                _p32(p), mom, rows, _p32(values), lr, eps, ways,
+                height=int(attrs['embed_height']),
+                tile=int(attrs.get('embed_tile', 8)))
+            return {'ParamOut': [p_new.astype(p.dtype)],
+                    'MomentOut': [mom_new]}
         if _pallas_rowwise(p, values):
             from .pallas.table_update import sparse_apply_adagrad
             p_new, mom_new = sparse_apply_adagrad(

@@ -428,7 +428,9 @@ def _autodiff_slice(ops, idx, loss_name):
 ICI_BASIS = ('ring collectives: allreduce moves 2(N-1)/N x payload '
              'bytes per device over ICI (reduce-scatter ring + '
              'all-gather ring); reduce_scatter / all_gather move '
-             '(N-1)/N each')
+             '(N-1)/N each; all_to_all keeps 1/N local and moves '
+             '(N-1)/N (the sharded-embedding lookup pays two: id '
+             'buckets out, gathered rows back)')
 
 
 def _collective_costs(program):

@@ -14,7 +14,10 @@ into an explicit pipeline:
   cross-checks it against the verifier mutation-test matrix.  After
   AMP comes sharding propagation (order 85, transpiler/sharding.py,
   enabled by PADDLE_TPU_MESH — stamps per-op PartitionSpecs + the
-  SPMD plan the executor pjit-lowers with); the analysis tail is
+  SPMD plan the executor pjit-lowers with), then the embedding-engine
+  lowering (order 87, ``apply_embed_lowering`` — rewrites lookups over
+  row-sharded tables onto the all-to-all + per-shard-apply route and
+  prices their collectives); the analysis tail is
   donation (order 90), the static cost model (order 95,
   transpiler/cost_model.py — after AMP so low-precision bytes count,
   after sharding so the collective table is priced), then the
@@ -195,6 +198,19 @@ def _sharding(program, ctx):
         feed_names=ctx.feed_names, feed_specs=ctx.feed_specs)}
 
 
+@register_pass('embed_shard', 87, 'embed',
+               enabled=lambda cfg: bool(cfg.mesh))
+def _embed_shard(program, ctx):
+    # right after sharding propagation (the embed registry it consumes
+    # lives on program._sharding_plan), before the analysis tail so
+    # the cost model prices the lookup all-to-alls it appends: lower
+    # lookups over row-sharded tables to the all-to-all + per-shard
+    # engine route (PADDLE_TPU_EMBED_SHARD; a no-op when the plan
+    # registered no row-sharded tables)
+    from . import sharding as sharding_mod
+    return {'embed': sharding_mod.apply_embed_lowering(program)}
+
+
 @register_pass('donation', 90, 'donation', kind='analysis',
                enabled=lambda cfg: cfg.level >= 1)
 def _donation(program, ctx):
@@ -258,9 +274,10 @@ def plan_key(program=None):
     from ..distributed._compat import mesh_key
     from ..ops.pallas.table_update import sparse_apply_mode
     from ..ops.pallas.dense_update import dense_apply_mode
+    from .sharding import embed_plan_key
     return ('pm', resolve_level(program), plan_key_component(),
             verify_mod.resolve_mode(None), sparse_apply_mode(),
-            dense_apply_mode(), mesh_key())
+            dense_apply_mode(), mesh_key(), embed_plan_key())
 
 
 # ---------------------------------------------------------------------------
@@ -399,6 +416,8 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
             report['amp'] = frag['amp']
         if frag.get('sharding') is not None:
             report['sharding'] = frag['sharding']
+        if frag.get('embed') is not None:
+            report['embed'] = frag['embed']
         if frag.get('cost') is not None:
             report['cost'] = frag['cost']
         if frag.get('memory') is not None:

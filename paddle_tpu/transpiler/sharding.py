@@ -39,20 +39,55 @@ Programs carrying ``parallel_do`` keep their explicit shard_map path:
 the pass skips them (one distribution mechanism per program).
 """
 from ..core import datatypes  # noqa: F401 (spec bytes go via cost_model)
-from ..distributed.spec_layout import (SpecLayout, build_param_specs,
-                                       replicated, spec_divisor)
+from ..distributed.spec_layout import (ACC_SUFFIX, SpecLayout,
+                                       _embedding_param_names,
+                                       build_param_specs, replicated,
+                                       spec_divisor)
 from . import cost_model as _cm
 
-__all__ = ['apply_sharding', 'RING_FACTORS', 'collective_ici_bytes']
+__all__ = ['apply_sharding', 'apply_embed_lowering', 'RING_FACTORS',
+           'collective_ici_bytes', 'embed_shard_enabled',
+           'embed_plan_key', 'EMBED_ROWWISE_OPS']
 
 # closed-form ICI traffic factors, as a fraction of the payload bytes:
 # ring allreduce moves each byte out (reduce-scatter ring) and back
 # (all-gather ring) = 2(N-1)/N; its two halves are (N-1)/N each.
+# all_to_all keeps 1/N of the payload local and sends the remaining
+# (N-1)/N across the interconnect — the sharded-embedding lookup pays
+# one such for the id buckets out and one for the gathered rows back.
 RING_FACTORS = {
     'allreduce': lambda n: 2.0 * (n - 1) / n,
     'reduce_scatter': lambda n: (n - 1) / n,
     'all_gather': lambda n: (n - 1) / n,
+    'all_to_all': lambda n: (n - 1) / n,
 }
+
+# op types allowed to carry embed_* attrs: the lookup itself plus the
+# optimizers with a true ROW-WISE SelectedRows rule (optim_ops sparse
+# branches -> per-shard Pallas apply).  A densifying optimizer
+# (momentum & co) scans the whole table and must never be routed
+# per-shard — transpiler/verify.py enforces this set statically.
+EMBED_ROWWISE_OPS = frozenset({'lookup_table', 'sgd', 'adagrad', 'adam'})
+
+_EMBED_OFF = ('off', '0', 'false', 'no', 'none')
+
+
+def embed_shard_enabled():
+    """Resolved PADDLE_TPU_EMBED_SHARD mode: True ('auto'/'on', the
+    default — row-shard lookup tables whenever the mesh has a model
+    axis) or False ('off' — the pre-engine behavior: tables follow the
+    generic param rule and lookups stay single-route)."""
+    from ..flags import FLAGS
+    return str(FLAGS.embed_shard).strip().lower() not in _EMBED_OFF
+
+
+def embed_plan_key():
+    """The embedding-engine component of the composite plan-cache key:
+    mode + bucket tile (both change the traced lookup/apply lowering,
+    so a flip must re-key every plan)."""
+    from ..flags import FLAGS
+    return ('on' if embed_shard_enabled() else 'off',
+            max(int(FLAGS.embed_bucket_tile), 1))
 
 
 def collective_ici_bytes(kind, n, payload_bytes):
@@ -104,11 +139,16 @@ def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
         # the ambient mesh; double-distributing would shard the shards
         return {'mesh': mesh_axes, 'skipped': 'parallel_do'}
 
-    layout = SpecLayout(axes_d)
+    embed_on = embed_shard_enabled()
+    # embed_pad pinned to the flag: an indivisible row split may only
+    # exist when the engine will sentinel-pad the stored table
+    layout = SpecLayout(axes_d, embed_pad=embed_on)
     batch_axis = layout.batch_axis
     batch_n = layout.axis_size(batch_axis) if batch_axis else 1
     param_specs = build_param_specs(program, mesh_axes, layout)
     batch = _cm._batch_binding(block, feed_specs)
+    embed = _embed_table(program, block, param_specs, axes_d) \
+        if embed_on else {}
 
     # -- feed specs ----------------------------------------------------
     feed_table = {}
@@ -234,6 +274,11 @@ def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
         'feeds': dict(feed_table),
         'divisors': divisors,
         'collectives': tuple(collectives),
+        # row-sharded lookup tables (+ their optimizer accumulators):
+        # true/padded heights + shard ways, recorded HERE (not in the
+        # embed lowering pass) so the verifier can excuse the
+        # pad-backed indivisible split the moment the spec exists
+        'embed': embed,
     }
 
     return {
@@ -243,4 +288,128 @@ def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
         'ops_annotated': ops_annotated,
         'collectives': len(collectives),
         'sharded_names': len(divisors),
+        'embed_tables': len(embed),
     }
+
+
+def _axis_label(entry):
+    """Human label for a spec's dim-0 entry ('fsdp', 'fsdp+tp', ...)."""
+    return '+'.join(_entry_axes(entry)) or 'none'
+
+
+def _embed_table(program, block, param_specs, axes_d):
+    """The row-sharded-table registry of one plan: for every
+    ``lookup_table`` weight whose param spec shards dim 0, the true
+    height, the engine's sentinel-padded height, the shard count, and
+    the state set (table + same-shaped optimizer accumulators — they
+    pad and shard together or the per-shard apply could not slice
+    them in lockstep)."""
+    from ..distributed import embedding_engine as _ee
+    embed = {}
+    for name in sorted(_embedding_param_names(program)):
+        spec = param_specs.get(name)
+        if not spec or spec[0] is None:
+            continue
+        ways = 1
+        for ax in _entry_axes(spec[0]):
+            ways *= int(axes_d.get(ax, 1))
+        if ways <= 1:
+            continue
+        try:
+            var = block.var_recursive(name)
+        except KeyError:
+            continue
+        shape = tuple(int(d) for d in var.shape)
+        if len(shape) != 2 or shape[0] < ways:
+            continue
+        state = [name]
+        for n, s in param_specs.items():
+            if n == name or s != spec:
+                continue
+            if n.startswith(name + '_') and \
+                    ACC_SUFFIX.fullmatch(n[len(name) + 1:]):
+                state.append(n)
+        embed[name] = {
+            'height': shape[0],
+            'padded': _ee.pad_height(shape[0], ways),
+            'ways': ways,
+            'axis': _axis_label(spec[0]),
+            'width': shape[1],
+            'state': tuple(sorted(state)),
+        }
+    return embed
+
+
+def apply_embed_lowering(program):
+    """The embed_shard REWRITE pass (PassManager order 87, right after
+    sharding propagation; everything it needs — the embed registry,
+    the batch binding — rides ``program._sharding_plan``): lower every
+    lookup over a row-sharded table
+    to the engine route — stamp ``embed_ways`` / ``embed_height`` /
+    ``embed_padded`` / ``embed_tile`` on the lookup op and on the
+    row-wise sparse optimizer ops applying into the table (the attrs
+    ops/embedding.py and ops/optim_ops.py route on), and append the
+    lookup's TWO all-to-alls (id buckets out, gathered rows back, each
+    ``(N-1)/N x bytes`` over ICI) to the plan's collective table so the
+    cost model prices them and the executor attributes them as
+    ``collective`` phase events."""
+    plan = getattr(program, '_sharding_plan', None) or {}
+    embed = plan.get('embed') or {}
+    report = {'tables': len(embed), 'lookups': 0, 'applies': 0,
+              'all_to_alls': 0}
+    if not embed:
+        return report
+    from ..distributed import embedding_engine as _ee
+    from ..flags import FLAGS
+    tile = max(int(FLAGS.embed_bucket_tile), 1)
+    block = program.global_block()
+    batch = plan.get('batch')
+    collectives = list(plan.get('collectives') or ())
+
+    def _stamp(op, e):
+        op.attrs['embed_ways'] = int(e['ways'])
+        op.attrs['embed_height'] = int(e['height'])
+        op.attrs['embed_padded'] = int(e['padded'])
+        op.attrs['embed_tile'] = tile
+
+    for op in block.ops:
+        if op.type == 'lookup_table':
+            w = (op.inputs.get('W') or [None])[0]
+            e = embed.get(w)
+            if e is None:
+                continue
+            _stamp(op, e)
+            report['lookups'] += 1
+            ids_name = (op.inputs.get('Ids') or [None])[0]
+            ids_spec = _cm._declared_spec(block, ids_name, batch)
+            unk = [0]
+            n_ids = _cm._prod(ids_spec[0], unk) if ids_spec else 1
+            cap = _ee.bucket_cap(n_ids, tile)
+            ways = int(e['ways'])
+            out_name = (op.outputs.get('Out') or [w])[0]
+            # ids out: [ways, cap] int32 buckets; rows back: the
+            # gathered [ways, cap, D] f32 row buffer
+            collectives.append(
+                {'name': ids_name or w, 'kind': 'all_to_all',
+                 'axis': e['axis'], 'n': ways,
+                 'bytes': ways * cap * 4})
+            collectives.append(
+                {'name': out_name, 'kind': 'all_to_all',
+                 'axis': e['axis'], 'n': ways,
+                 'bytes': ways * cap * int(e['width']) * 4})
+            report['all_to_alls'] += 2
+        elif op.type in EMBED_ROWWISE_OPS and \
+                op.attrs.get('op_role') == 'optimize':
+            pname = (op.inputs.get('Param') or [None])[0]
+            e = embed.get(pname)
+            if e is None:
+                continue
+            _stamp(op, e)
+            report['applies'] += 1
+
+    plan['collectives'] = tuple(collectives)
+    # staging may now pad: the executor only sentinel-pads stored
+    # state once the ops were actually rewritten to the engine route
+    plan['embed_lowered'] = True
+    program._sharding_plan = plan
+    return report

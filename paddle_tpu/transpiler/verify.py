@@ -38,7 +38,15 @@ Checks (each returns precise diagnostics, never mutates the program):
   not read that name *after* it (read-after-last-legal-use).
 - **sharding-annotation consistency** (post-sharding-propagation): every
   ``sharding_in``/``sharding_out`` stamp and param-plan entry names only
-  axes the mesh has and splits only divisible dims.
+  axes the mesh has and splits only divisible dims (a row-sharded
+  embedding table's declared height may be indivisible when the plan's
+  embed registry records its sentinel-padded height, which must divide).
+- **embed-lowering consistency** (post-embed_shard): ``embed_*`` attrs
+  appear only on lookups and ROW-WISE sparse applies (densifying
+  consumers would scan the whole table), carry the minimal divisible
+  pad of the true height, and agree with the plan's embed registry —
+  the static half of "a sharded table's lookup/apply only ever
+  addresses local row ranges".
 
 Waivers are explicit, per-op, and commented (the allowlists below) —
 the contract is fix-the-op, not loosen-the-checker.
@@ -500,11 +508,15 @@ def _iter_spec_axes(spec):
             yield entry
 
 
-def _check_one_spec(program, where, name, spec, axes, errors):
+def _check_one_spec(program, where, name, spec, axes, errors,
+                    pad_excused=None):
     """One (var, spec) annotation: axes must exist on the mesh, the
     spec must be a per-dim tuple, and every concretely-sized sharded
     dim must divide by the product of its axis sizes (a -1/unknown dim
-    carries no verdict)."""
+    carries no verdict).  ``pad_excused`` maps a row-sharded embedding
+    state name to its (height, padded) pair — dim 0 of those vars may
+    be indivisible AS DECLARED because the engine sentinel-pads the
+    stored table to ``padded``, which must itself divide."""
     if spec is None:
         return  # un-propagated name: no claim, nothing to check
     if not isinstance(spec, tuple):
@@ -529,11 +541,15 @@ def _check_one_spec(program, where, name, spec, axes, errors):
             "%s: sharding spec for %r has %d entries but the var is "
             "rank %d" % (where, name, len(spec), len(shape)))
         return
-    for dim, entry in zip(shape, spec):
+    for i, (dim, entry) in enumerate(zip(shape, spec)):
         div = 1
         for ax in _iter_spec_axes((entry,)):
             div *= int(axes.get(ax, 1))
         if div > 1 and dim not in (-1, None) and int(dim) % div:
+            pad = (pad_excused or {}).get(name)
+            if i == 0 and pad is not None and int(dim) == pad[0] and \
+                    pad[1] % div == 0:
+                continue  # engine-padded table rows: padded divides
             errors.append(
                 "%s: sharding spec for %r splits a dim of size %d %d "
                 "ways — not divisible" % (where, name, int(dim), div))
@@ -556,6 +572,7 @@ def _check_sharding(program, errors):
             "sharding pass stamped a plan it could not have built")
         return
     block = program.global_block()
+    pad_excused = _embed_pad_excused(plan)
     for i, op in enumerate(block.ops):
         for key in ('sharding_in', 'sharding_out'):
             tab = op.attrs.get(key)
@@ -572,10 +589,110 @@ def _check_sharding(program, errors):
                                   % (where, pair))
                     continue
                 _check_one_spec(program, where, pair[0], pair[1],
-                                axes, errors)
+                                axes, errors, pad_excused)
     for name, spec in sorted((plan.get('params') or {}).items()):
         _check_one_spec(program, "sharding plan param", name, spec,
-                        axes, errors)
+                        axes, errors, pad_excused)
+    _check_embed(program, plan, errors)
+
+
+def _embed_pad_excused(plan):
+    """{state name: (true height, padded height)} for every
+    row-sharded embedding table and its accumulators — the names whose
+    declared dim 0 may legally be indivisible (the executor stages
+    them sentinel-padded to the divisible height)."""
+    out = {}
+    for e in (plan.get('embed') or {}).values():
+        for n in e.get('state', ()):
+            out[n] = (int(e['height']), int(e['padded']))
+    return out
+
+
+# ops allowed to carry embed_* attrs — import kept lazy/failsafe so a
+# broken sharding module cannot take the whole verifier down with it
+def _embed_rowwise_ops():
+    try:
+        from .sharding import EMBED_ROWWISE_OPS
+        return EMBED_ROWWISE_OPS
+    except Exception:  # pragma: no cover
+        return frozenset({'lookup_table', 'sgd', 'adagrad', 'adam'})
+
+
+def _check_embed(program, plan, errors):
+    """Row-sharded-table lowering invariants: every op stamped with
+    ``embed_*`` attrs must (a) be a lookup or a ROW-WISE sparse apply
+    — anything else (a densifying optimizer, an arbitrary op) scans
+    the whole table and breaks the locals-only contract; (b) carry a
+    self-consistent (ways, height, padded, tile) tuple whose padded
+    height divides into >= 1 local rows per shard — the static proof
+    that the engine's buckets only ever address LOCAL row ranges
+    ``[0, padded/ways)``; and (c) agree with the plan's embed registry
+    for the table it targets."""
+    embed = plan.get('embed') or {}
+    block = program.global_block()
+    allowed = _embed_rowwise_ops()
+    for i, op in enumerate(block.ops):
+        ways = op.attrs.get('embed_ways')
+        if ways is None:
+            continue
+        where = _op_str(0, i, op)
+        if op.type not in allowed:
+            errors.append(
+                "%s carries embed_ways but is not a lookup/row-wise "
+                "sparse apply — a densifying consumer would address "
+                "the whole table, not local row ranges" % where)
+            continue
+        height = op.attrs.get('embed_height')
+        padded = op.attrs.get('embed_padded')
+        tile = op.attrs.get('embed_tile')
+        vals = (ways, height, padded, tile)
+        if not all(isinstance(v, (int, np.integer)) for v in vals):
+            errors.append(
+                "%s: embed attrs must be ints, got ways=%r height=%r "
+                "padded=%r tile=%r" % ((where,) + vals))
+            continue
+        ways, height, padded, tile = (int(v) for v in vals)
+        if ways < 2:
+            errors.append("%s: embed_ways must be >= 2, got %d"
+                          % (where, ways))
+        if tile < 1:
+            errors.append("%s: embed_tile must be >= 1, got %d"
+                          % (where, tile))
+        if padded % max(ways, 1):
+            errors.append(
+                "%s: embed_padded %d does not divide %d ways — the "
+                "per-shard slices would be ragged" % (where, padded,
+                                                      ways))
+        elif not (height <= padded < height + ways):
+            errors.append(
+                "%s: embed_padded %d is not the minimal %d-divisible "
+                "pad of height %d — local row ranges would drift from "
+                "the plan's" % (where, padded, ways, height))
+        tname = ((op.inputs.get('W') or op.inputs.get('Param')
+                  or [None]))[0]
+        e = embed.get(tname)
+        if e is None:
+            errors.append(
+                "%s targets table %r which the sharding plan's embed "
+                "registry does not row-shard" % (where, tname))
+            continue
+        if (int(e['ways']), int(e['height']), int(e['padded'])) != \
+                (ways, height, padded):
+            errors.append(
+                "%s: embed attrs (ways=%d height=%d padded=%d) "
+                "disagree with the plan's registry for %r (ways=%d "
+                "height=%d padded=%d)"
+                % (where, ways, height, padded, tname,
+                   int(e['ways']), int(e['height']), int(e['padded'])))
+        try:
+            v = block.var_recursive(tname)
+            if v.shape and int(v.shape[0]) != height:
+                errors.append(
+                    "%s: embed_height %d disagrees with %r's declared "
+                    "height %d" % (where, height, tname,
+                                   int(v.shape[0])))
+        except KeyError:
+            pass
 
 
 # ---------------------------------------------------------------------------

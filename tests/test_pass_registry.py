@@ -31,15 +31,16 @@ def test_registered_passes_surface():
     from paddle_tpu.transpiler import pass_manager as pm
     names = [p.name for p in pm.registered_passes()]
     assert names == ['dce', 'constant_fold', 'cse', 'dce_sweep', 'amp',
-                     'sharding', 'donation', 'cost_model',
-                     'memory_model']
+                     'sharding', 'embed_shard', 'donation',
+                     'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(1, None)] == [
         'dce', 'donation', 'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(0, 'bf16')] == ['amp']
     assert [p.name for p in pm.build_plan(2, 'bf16')] == [
         'dce', 'constant_fold', 'cse', 'dce_sweep', 'amp', 'donation',
         'cost_model', 'memory_model']
-    # the sharding pass joins the plan only under a mesh config
+    # the sharding + embed-lowering passes join only under a mesh
     assert [p.name for p in pm.build_plan(1, None, (('dp', 2),))] == [
-        'dce', 'sharding', 'donation', 'cost_model', 'memory_model']
+        'dce', 'sharding', 'embed_shard', 'donation', 'cost_model',
+        'memory_model']
     assert [p.name for p in pm.build_plan(0, None)] == []

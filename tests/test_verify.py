@@ -275,6 +275,17 @@ def _mut_corrupt_sharding_axis(program):
             return
 
 
+def _mut_stamp_embed_on_non_rowwise(program):
+    # stamp embed routing attrs on an op that is neither a lookup nor
+    # a row-wise sparse apply — such a consumer would scan the whole
+    # table, so the embed-consistency check must catch and attribute it
+    op = program.global_block().ops[0]
+    op.attrs['embed_ways'] = 2
+    op.attrs['embed_height'] = 7
+    op.attrs['embed_padded'] = 8
+    op.attrs['embed_tile'] = 8
+
+
 # The verifier mutation-test matrix: every REWRITE pass registered in
 # pass_manager.PASSES must appear here (enforced statically by
 # tools/check_pass_registry.py) with a corruption the verifier catches.
@@ -285,6 +296,7 @@ PASS_MUTATIONS = {
     'dce_sweep': _mut_drop_fetch_producer,
     'amp': _mut_duplicate_weaver_cast,
     'sharding': _mut_corrupt_sharding_axis,
+    'embed_shard': _mut_stamp_embed_on_non_rowwise,
 }
 
 
@@ -292,8 +304,8 @@ PASS_MUTATIONS = {
 def test_mutation_is_caught_and_attributed(pass_name, monkeypatch):
     main, fetch = _data_program()
     amp = 'bf16' if pass_name == 'amp' else '0'
-    # the sharding pass only joins the plan under a mesh config
-    mesh = 'dp=2' if pass_name == 'sharding' else ''
+    # the sharding + embed passes only join the plan under a mesh
+    mesh = 'dp=2' if pass_name in ('sharding', 'embed_shard') else ''
     # control: the uncorrupted pipeline verifies clean at every_pass
     pm.run_pipeline(main, fetch_names=(fetch,), feed_names=('x',),
                     level=2, amp_mode=amp, mesh=mesh,
