@@ -1,0 +1,31 @@
+"""Static host-code analysis: the concurrency analyzer + lock watchdog.
+
+PR 8 gave the *program IR* a verifier; nothing checked the *host code*
+that runs it — and the host side is where the threads live: the
+batching dispatcher, the fleet health loop, the online watchdog, the
+reader workers.  This package is the host-side counterpart:
+
+- :mod:`concurrency` — an AST-based analyzer over ``paddle_tpu/``:
+  discovers thread entrypoints, infers a guarded-by map per
+  lock-owning class (which ``self._x`` fields are accessed inside
+  ``with self._lock`` blocks), reports fields written under a lock on
+  one path but read/written without it on a thread-reachable path,
+  builds the lock-acquisition order graph (interprocedural through a
+  per-class one-level call graph) and reports cycles as potential
+  deadlocks.  Waivers are commented annotations in the source
+  (``# lock: guarded_by(_lock)`` / ``# lock: unguarded-ok(<reason>)``)
+  in the transpiler/verify.py allowlist style: documented, not
+  silenced.  Wired into tier-1 via tools/check_concurrency.py and
+  tests/test_concurrency_lint.py — the repo sweep must report zero
+  unwaived findings.
+- :mod:`lockdebug` — the opt-in runtime counterpart
+  (``PADDLE_TPU_LOCK_DEBUG=1``): lock factories the threaded modules
+  create their locks through, recording per-thread acquisition stacks
+  and asserting the static acquisition-order graph at runtime
+  (violations counted in ``paddle_tpu_lock_order_violations_total``).
+  Zero-cost when disabled: the factories return plain
+  ``threading.Lock``/``Condition`` objects.
+"""
+from . import concurrency, lockdebug
+
+__all__ = ['concurrency', 'lockdebug']

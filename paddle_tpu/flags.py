@@ -359,6 +359,20 @@ DEFINE_int('embed_cache_rows', 0,
            'serve lookups locally (write-through coherent, eviction '
            'invalidates), so the common case moves zero interconnect '
            'bytes.  0 (default) builds no cache')
+DEFINE_bool('lock_debug', False,
+            'runtime lock watchdog (paddle_tpu.analysis.lockdebug): '
+            'when on, the threaded serving/online modules create '
+            'their locks through checking wrappers that record '
+            'per-thread acquisition stacks and assert the static '
+            'concurrency analyzer\'s lock-acquisition-order graph at '
+            'runtime — acquiring B while holding A when B-before-A '
+            'holds elsewhere (statically, or earlier in this process) '
+            'counts a paddle_tpu_lock_order_violations_total and '
+            'records the thread/held-locks/stack for forensics.  Off '
+            '(default) the factories return plain threading '
+            'primitives: zero added cost, the PR-2 cached-bool '
+            'contract.  Read when a lock is CREATED, so flips apply '
+            'to servers/fleets/controllers constructed afterwards')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -55,6 +55,7 @@ import numpy as np
 import jax
 
 from .. import observability as _obs
+from ..analysis import lockdebug as _lkd
 from ..core.executor import _maybe_enable_compilation_cache
 from ..observability import timeline as _tlm
 from .serving import InferenceServer, export_inference
@@ -323,10 +324,14 @@ class BatchingInferenceServer(object):
 
         # one lock, two wait-sets: the dispatcher sleeps on _cv, clients
         # blocked on backpressure sleep on _cv_space — so a submit wakes
-        # exactly the dispatcher, not a herd of queued clients
+        # exactly the dispatcher, not a herd of queued clients.  Both
+        # conditions carry ONE watchdog name: they are one lock in the
+        # acquisition-order graph (PADDLE_TPU_LOCK_DEBUG)
         lock = threading.Lock()
-        self._cv = threading.Condition(lock)
-        self._cv_space = threading.Condition(lock)
+        self._cv = _lkd.make_condition(
+            'BatchingInferenceServer._cv', lock)
+        self._cv_space = _lkd.make_condition(
+            'BatchingInferenceServer._cv', lock)
         self._pending = deque()   # guarded by _cv
         self._pending_rows = 0    # running row total of _pending
         self._in_flight = 0       # batches dispatched, not yet synced

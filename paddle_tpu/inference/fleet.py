@@ -84,6 +84,7 @@ import numpy as np
 
 from .. import io as _io
 from .. import observability as _obs
+from ..analysis import lockdebug as _lkd
 from ..flags import FLAGS
 from ..observability import timeline as _tlm
 from .batching import BatchingInferenceServer
@@ -346,8 +347,8 @@ class ServingFleet(object):
                  health_interval_ms=None, drain_timeout_s=None,
                  hbm_budget_bytes=None, **server_kwargs):
         self._fid = 'f%d' % next(_fleet_seq)
-        self._lock = threading.Lock()
-        self._deploy_lock = threading.Lock()
+        self._lock = _lkd.make_lock('ServingFleet._lock')
+        self._deploy_lock = _lkd.make_lock('ServingFleet._deploy_lock')
         self._rr = itertools.count()
         self._req_seq = itertools.count()  # fleet-level request ids
         # warn-only HBM budget for the deploy() resident-bytes
@@ -579,20 +580,29 @@ class ServingFleet(object):
                     self._note_success(rep)
 
     # -- replica lifecycle ---------------------------------------------
-    def _new_replica(self, vname, vdir, paths, share_with=None):
+    def _new_replica(self, vname, vdir, paths, share_with=None,
+                     throttle=False):
         """Build one replica.  ``share_with`` (a sibling replica of the
         SAME version) makes the new server share the sibling's
         deserialized artifacts and compiled executables — in-process
         replicas are dispatch lanes over one servable, so a version's
         warmup cost is paid once, not once per replica, and the
-        serving threads are disturbed for one build, not N."""
+        serving threads are disturbed for one build, not N.
+
+        ``throttle`` — the caller decided (under ``_lock``, where the
+        replica set may be read) that a live set is serving next to
+        this build, so bucket compiles should be paced.  The decision
+        is an argument rather than a ``self._replicas`` read because
+        this method runs on the backgrounded warmup thread, which
+        holds no fleet lock (the concurrency analyzer flagged the
+        previous in-method read)."""
         rid = 'r%d' % next(_replica_seq)
         t0 = time.perf_counter()
         kw = dict(self._server_kwargs)
         kw.setdefault('warmup', True)
         if share_with is not None:
             kw['share_artifacts_with'] = share_with.server
-        elif self._replicas:
+        elif throttle:
             # building a fresh servable NEXT TO live traffic (deploy,
             # cold add): throttle the bucket compiles so the serving
             # threads get the cores back between bursts
@@ -623,10 +633,12 @@ class ServingFleet(object):
                     (r for r in self._replicas
                      if r.version == vname
                      and r.state in (READY, UNROUTABLE)), None)
+                live = bool(self._replicas)
             paths = _io.bucket_artifacts(vdir)
             rep = _run_backgrounded(
                 lambda: self._new_replica(vname, vdir, paths,
-                                          share_with=share))
+                                          share_with=share,
+                                          throttle=live))
             with self._lock:
                 if self._closed:
                     closed = True
@@ -716,6 +728,7 @@ class ServingFleet(object):
                 n = (int(replicas) if replicas is not None
                      else (len(self._replicas)
                            or self._default_replicas))
+                live = bool(self._replicas)
             self._precheck_hbm_budget(
                 vname, paths,
                 self._hbm_budget if hbm_budget_bytes is None
@@ -730,7 +743,8 @@ class ServingFleet(object):
                     new.append(_run_backgrounded(
                         lambda: self._new_replica(
                             vname, vdir, paths,
-                            share_with=new[0] if new else None)))
+                            share_with=new[0] if new else None,
+                            throttle=live)))
             except Exception:
                 self._retire(new)
                 raise
@@ -828,9 +842,16 @@ class ServingFleet(object):
         add_replica, and at a deploy's overlap moment — the incoming
         set is built and the outgoing set still serves."""
         v = self._resident_total(extra=extra)
-        if v > self._resident_watermark:
-            self._resident_watermark = v
-            self._m.resident_watermark.set(v)
+        # compare-and-advance under _lock: the watermark is read by
+        # stats() on caller threads, and _resident_total above takes
+        # _lock itself, so the critical section starts only here.  The
+        # gauge publishes INSIDE it too — set outside, a descheduled
+        # loser of the compare could overwrite a higher value and
+        # leave /metrics below stats() until the next advance
+        with self._lock:
+            if v > self._resident_watermark:
+                self._resident_watermark = v
+                self._m.resident_watermark.set(v)
         return v
 
     def _precheck_hbm_budget(self, vname, paths, budget):
@@ -899,6 +920,7 @@ class ServingFleet(object):
             version = self._version
             by_reason = dict(self._rollbacks_by_reason)
             last_reason = self._last_deploy_reason
+            watermark = self._resident_watermark
         per = []
         for r in reps:
             s = r.server.stats()
@@ -934,7 +956,7 @@ class ServingFleet(object):
             'unroutable_marks': int(m.unroutable_marks.value),
             'health_probes': int(m.probes.value),
             'resident_bytes': self._resident_total(),
-            'resident_bytes_watermark': self._resident_watermark,
+            'resident_bytes_watermark': watermark,
             'hbm_budget_bytes': self._hbm_budget,
             'hbm_budget_precheck_failures':
                 int(m.budget_precheck_failures.value),

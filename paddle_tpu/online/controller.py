@@ -48,13 +48,13 @@ exported.
 """
 import logging
 import os
-import threading
 import time
 
 import numpy as np
 
 from .. import io as _io
 from .. import observability as _obs
+from ..analysis import lockdebug as _lkd
 from ..evaluator import StreamingAUC
 from ..flags import FLAGS
 
@@ -193,11 +193,12 @@ class OnlineController(object):
         self._last_action_t = None   # last deploy/rollback (p99 grace)
         self._bins = int(auc_bins)
         self.pid = trainer.pid
-        self._lock = threading.Lock()
+        self._lock = _lkd.make_lock('OnlineController._lock')
         # serializes the fleet-facing actions (promote, auto_rollback)
         # so a watchdog rollback can never interleave with a promote —
         # and the rollback re-checks the serving version under it
-        self._action_lock = threading.Lock()
+        self._action_lock = _lkd.make_lock(
+            'OnlineController._action_lock')
         # per-version freshness stamps: a version's age is anchored at
         # its EXPORT time, so rolling back to an old version brings its
         # real age (and possibly an SLO violation) back with it
@@ -320,8 +321,14 @@ class OnlineController(object):
         if self._serving_eval_fn is not None:
             serving_auc, _ = self._auc_of(self._serving_eval_fn,
                                           holdout_rows)
-        elif self.promoted_auc is not None:
-            serving_auc = self.promoted_auc
+        else:
+            # snapshot under _lock: a concurrent watchdog rollback
+            # clears promoted_auc mid-gate, and the fallback term must
+            # read one consistent value, not whatever interleaves
+            with self._lock:
+                promoted = self.promoted_auc
+            if promoted is not None:
+                serving_auc = promoted
         reasons = []
         if auc < self.auc_floor:
             reasons.append('auc_floor')
@@ -373,12 +380,17 @@ class OnlineController(object):
             self._set_serving_version(version)
             with self._lock:
                 self._last_action_t = time.monotonic()
-            # a gateless (forced) promote has NO holdout score: keep
-            # the predecessor's number and check() would judge this
-            # version's live AUC against a different model's gate —
-            # None limits the watchdog to the absolute live floor
-            self.promoted_auc = (gate_verdict.get('auc')
-                                 if gate_verdict is not None else None)
+                # a gateless (forced) promote has NO holdout score:
+                # keep the predecessor's number and check() would
+                # judge this version's live AUC against a different
+                # model's gate — None limits the watchdog to the
+                # absolute live floor.  Written under _lock: check()
+                # reads it there, and a watchdog decision must see
+                # either the pre-promote or post-promote value, never
+                # a publish racing the window reset below
+                self.promoted_auc = (gate_verdict.get('auc')
+                                     if gate_verdict is not None
+                                     else None)
             # a fresh model ends any staleness window
             self.check_freshness()
             # fresh version, fresh live window: outcomes of the old

@@ -40,11 +40,11 @@ commits matter (the trainer), pull from the tail directly and commit at
 quiescent round boundaries, which is what ``OnlineTrainer`` does.
 """
 import os
-import threading
 import time
 
 import numpy as np
 
+from ..analysis import lockdebug as _lkd
 from ..flags import FLAGS
 
 __all__ = ['ClickstreamWriter', 'ClickstreamTail', 'format_row',
@@ -106,7 +106,7 @@ class ClickstreamWriter(object):
         # sign-flipped between the two regimes so drift actually
         # inverts what the head ids mean
         self._id_mod = 17 + 2 * np.arange(self.n_slots)
-        self._lock = threading.Lock()
+        self._lock = _lkd.make_lock('ClickstreamWriter._lock')
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         if not os.path.exists(path):

@@ -1,0 +1,50 @@
+"""tools/lint_all.py wiring (tier-1).
+
+One entrypoint runs every tools/check_*.py with a summary table; this
+test keeps it — and every future checker — wired into tier-1, so a new
+checker cannot be added half-wired and silently skipped.
+"""
+import importlib.util
+import os
+
+
+def _load_lint_all(tools_dir=None):
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'lint_all.py')
+    spec = importlib.util.spec_from_file_location('lint_all', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if tools_dir is not None:
+        mod._TOOLS = tools_dir
+    return mod
+
+
+def test_every_checker_discovered_and_green():
+    mod = _load_lint_all()
+    names = mod.discover()
+    # the full checker roster; a removed checker must be removed here
+    # deliberately, a new one joins automatically via discovery
+    for expected in ('check_amp_lists', 'check_concurrency',
+                     'check_flags_doc', 'check_metric_names',
+                     'check_pass_registry'):
+        assert expected in names, names
+    results = mod.run_all()
+    assert set(results) == set(names)
+    failing = {n: errs for n, (errs, _w) in results.items() if errs}
+    assert failing == {}, failing
+
+
+def test_contractless_checker_cannot_hide(tmp_path):
+    """A tools/check_*.py without check() is a FAILURE, not a skip —
+    the wiring contract every checker rides into tier-1 on."""
+    (tmp_path / 'check_good.py').write_text(
+        'def check():\n    return []\n')
+    (tmp_path / 'check_nocontract.py').write_text(
+        'def lint():\n    return []\n')
+    (tmp_path / 'check_crashes.py').write_text(
+        'def check():\n    raise RuntimeError("boom")\n')
+    mod = _load_lint_all(tools_dir=str(tmp_path))
+    results = mod.run_all()
+    assert results['check_good'][0] == []
+    assert 'defines no check()' in results['check_nocontract'][0][0]
+    assert 'boom' in results['check_crashes'][0][0]

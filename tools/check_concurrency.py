@@ -1,0 +1,69 @@
+"""Static concurrency sweep over paddle_tpu/ (guarded-by + lock order).
+
+Runs paddle_tpu.analysis.concurrency over every package module and
+fails on any UNWAIVED finding:
+
+- a field written under a lock on one path but read/written without it
+  on a thread-reachable path (Eraser-style lockset inference, with
+  Condition alias groups and caller-holds propagation);
+- a ``# lock: guarded_by(_x)`` contract violated;
+- a cycle in the lock-acquisition order graph (potential deadlock);
+- a waiver with an empty reason, or an annotation attached to nothing.
+
+Benign findings are waived IN THE SOURCE with
+``# lock: unguarded-ok(<reason>)`` on (or right above) the field's
+assignment — documented debts the sweep lists, never silence.
+
+Runs standalone (``python tools/check_concurrency.py``, exit 1 on
+failure, ``-v`` prints the waived debts, thread entrypoints, and the
+order graph) and in tier-1 via tests/test_concurrency_lint.py, which
+imports ``check()`` — the same wiring as every other tools/check_*.py.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _report():
+    from paddle_tpu.analysis import concurrency
+    return concurrency.analyze_package(
+        os.path.join(_REPO, 'paddle_tpu'))
+
+
+def check():
+    """Returns a list of human-readable error strings (empty = OK)."""
+    return _report().errors()
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    verbose = '-v' in argv or '--verbose' in argv
+    rep = _report()
+    errors = rep.errors()
+    for e in errors:
+        print('check_concurrency: %s' % e, file=sys.stderr)
+    if verbose:
+        print('thread entrypoints (%d):' % len(rep.entrypoints))
+        for path, lineno, desc in rep.entrypoints:
+            print('  %s:%d  %s' % (path, lineno, desc))
+        print('lock-order edges (%d):' % len(rep.order_edges))
+        for (a, b), sites in sorted(rep.order_edges.items()):
+            print('  %s -> %s  (%s:%d)' % (a, b, sites[0][0],
+                                           sites[0][1]))
+        print('waived findings (%d):' % len(rep.waived))
+        for f, reason in rep.waived:
+            print('  %s:%d  %s.%s [%s]  -- %s'
+                  % (f.path, f.lineno, f.cls, f.field, f.kind, reason))
+    if errors:
+        return 1
+    print('check_concurrency: OK (%d lock-owning classes, %d thread '
+          'entrypoints, %d order edges, %d waived findings, 0 '
+          'unwaived)' % (rep.classes, len(rep.entrypoints),
+                         len(rep.order_edges), len(rep.waived)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""One entrypoint for every static checker in tools/.
+
+``python tools/lint_all.py`` discovers every ``tools/check_*.py``
+module, runs its ``check()`` (the shared contract: a list of
+human-readable error strings, empty = OK), and prints one summary
+table.  Exit 1 when any checker fails — or when a ``check_*.py`` file
+exists WITHOUT a ``check()`` function, so a new checker cannot be
+added half-wired and silently skipped.
+
+Tier-1 wiring: tests/test_lint_all.py imports :func:`run_all` and
+asserts every discovered checker passes, which also pins that every
+checker stays discoverable (the drift mode where a checker script
+exists but nothing runs it).
+"""
+import importlib.util
+import os
+import sys
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _REPO)
+
+
+def discover():
+    """Sorted module names of every tools/check_*.py."""
+    return sorted(
+        fn[:-3] for fn in os.listdir(_TOOLS)
+        if fn.startswith('check_') and fn.endswith('.py'))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_TOOLS, name + '.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_all():
+    """{checker name: (errors, wall_s)} over every discovered checker.
+    A checker that does not expose ``check()`` or whose ``check()``
+    raises reports that as its single error instead of crashing the
+    whole run."""
+    out = {}
+    for name in discover():
+        t0 = time.perf_counter()
+        try:
+            mod = _load(name)
+            fn = getattr(mod, 'check', None)
+            if fn is None:
+                errors = ["%s.py defines no check() — every "
+                          "tools/check_*.py must expose the shared "
+                          "contract (list of error strings, empty = "
+                          "OK) so lint_all and tier-1 can run it"
+                          % name]
+            else:
+                errors = list(fn())
+        except Exception as e:  # a crashing checker is a failing one
+            errors = ['%s raised: %r' % (name, e)]
+        out[name] = (errors, time.perf_counter() - t0)
+    return out
+
+
+def main():
+    results = run_all()
+    width = max(len(n) for n in results) if results else 10
+    print('%-*s  %-6s  %8s  %s' % (width, 'checker', 'status',
+                                   'wall', 'errors'))
+    failed = 0
+    for name in sorted(results):
+        errors, wall = results[name]
+        status = 'OK' if not errors else 'FAIL'
+        failed += bool(errors)
+        print('%-*s  %-6s  %7.2fs  %d'
+              % (width, name, status, wall, len(errors)))
+    for name in sorted(results):
+        for e in results[name][0]:
+            print('%s: %s' % (name, e), file=sys.stderr)
+    if failed:
+        print('lint_all: %d/%d checkers FAILED' % (failed,
+                                                   len(results)),
+              file=sys.stderr)
+        return 1
+    print('lint_all: OK (%d checkers)' % len(results))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
