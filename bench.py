@@ -8,7 +8,16 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The whole train step (forward + backward + momentum update) is one jitted
 XLA program with donated parameter buffers — steady-state steps do zero
 host work beyond the feed.
+
+Autotuning (ISSUE 16): ``--tune search`` runs the cost-model-pruned
+measured search (paddle_tpu.tuning) over amp / flat-tile budget /
+prefetch chunk / train batch / run_steps K and persists the winners;
+``--tune cached`` starts from persisted winners with zero search;
+``--tune off`` (default) is the untuned bench, bitwise as before.
+``--roofline`` attaches the top-ops roofline report; ``--tune-trace``
+(or PADDLE_TPU_TUNE_TRACE=1) prints the search trace to stderr.
 """
+import argparse
 import json
 import os
 import sys
@@ -20,8 +29,138 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMG_S = 61.0  # reference P40 fp32, batch 64
 
+# flag-scope tunables this bench searches (applied via env overrides);
+# train_batch / run_steps_k are bench-scope: searched by rebuilding the
+# program / resizing the scan below
+_FLAG_TUNABLES = ('amp', 'flat_tile_budget', 'device_prefetch_chunk')
+_BENCH_TUNABLES = ('train_batch', 'run_steps_k')
 
-def main():
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--tune', choices=('off', 'cached', 'search'),
+                    default=os.environ.get('PADDLE_TPU_TUNE') or 'off')
+    ap.add_argument('--roofline', action='store_true')
+    ap.add_argument('--tune-trace', action='store_true')
+    args, _rest = ap.parse_known_args(argv)
+    if args.tune_trace:
+        os.environ['PADDLE_TPU_TUNE_TRACE'] = '1'
+    return args
+
+
+def _autotune(mode, build_prog, image_shape, classes, batch0, k0,
+              on_tpu):
+    """Search (or cache-load) winners; returns (batch, k, info) with
+    flag-scope winners applied to the process env for the headline run.
+    The objective is seconds per image (model and measurement agree),
+    so batch candidates compare fairly."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.tuning import (cache as tcache, registry,
+                                   runtime as trt, search as tsearch)
+
+    tun = [registry.tunable(n)
+           for n in _FLAG_TUNABLES + _BENCH_TUNABLES]
+    budget = None  # Autotuner default (FLAGS.tune_measure_budget)
+    if not on_tpu:
+        # CPU smoke: every candidate recompiles the step, so clamp the
+        # domains (and skip K — the CPU measurement caps it anyway) so
+        # a search finishes in seconds, not minutes
+        clamp = {'train_batch': tuple(
+                     v for v in registry.tunable('train_batch').domain
+                     if v <= max(batch0, 32)),
+                 'flat_tile_budget': (1 << 20, 4 << 20),
+                 'device_prefetch_chunk': (0, 2)}
+        tun = [registry.Tunable(t.name, clamp.get(t.name, t.domain),
+                                t.default, t.subsystem, t.env,
+                                scope=t.scope, help=t.help,
+                                feasible=t.feasible)
+               for t in tun if t.name != 'run_steps_k']
+        budget = 8
+    base = registry.current_config(tun)
+    base['train_batch'] = batch0
+    if any(t.name == 'run_steps_k' for t in tun):
+        base['run_steps_k'] = k0
+    rng = np.random.default_rng(0)
+
+    def _flag_part(cfg):
+        return {n: cfg[n] for n in _FLAG_TUNABLES}
+
+    def model_fn(cfg):
+        b = int(cfg.get('train_batch', batch0))
+        prog, _startup, loss = build_prog(b)
+        with registry.applied(_flag_part(cfg)):
+            m = trt.model_program(
+                prog, fetch_names=(loss.name,),
+                feed_specs={'img': ((b,) + image_shape, 'float32'),
+                            'label': ((b, 1), 'int32')})
+        if m is None:
+            return None
+        return {'score': m['score'] / b, 'peak_bytes': m['peak_bytes']}
+
+    def measure_fn(cfg):
+        b = int(cfg.get('train_batch', batch0))
+        kk = int(cfg.get('run_steps_k', k0))
+        if not on_tpu:
+            kk = min(kk, 5)  # CPU smoke: keep the search bounded
+        prog, startup, loss = build_prog(b)
+        images = rng.normal(size=(b,) + image_shape).astype(np.float32)
+        labels = rng.integers(0, classes, (b, 1)).astype(np.int32)
+        with registry.applied(_flag_part(cfg)):
+            scope = fluid.core.scope.Scope()
+            with fluid.scope_guard(scope):
+                place = fluid.TPUPlace(0) if on_tpu else \
+                    fluid.CPUPlace()
+                exe = fluid.Executor(place)
+                exe.run(startup)
+                dev = place.jax_device()
+                staged = {'img': jax.device_put(images, dev),
+                          'label': jax.device_put(labels, dev)}
+                out = exe.run_steps(prog, feed=staged,
+                                    fetch_list=[loss], repeat=kk,
+                                    return_numpy=False)
+                jax.block_until_ready(out[0])
+                t0 = time.perf_counter()
+                out = exe.run_steps(prog, feed=staged,
+                                    fetch_list=[loss], repeat=kk,
+                                    return_numpy=False)
+                jax.block_until_ready(out[0])
+                return (time.perf_counter() - t0) / (kk * b)
+
+    key = trt.cache_key_for(build_prog(batch0)[0])
+    result = tsearch.autotune(model_fn, measure_fn, tunables=tun,
+                              cache=tcache.TuneCache(), cache_key=key,
+                              mode=mode, measure_budget=budget,
+                              base=base)
+    if result is None:
+        return batch0, k0, None
+    if FLAGS.tune_trace:
+        print(result.format_trace(), file=sys.stderr)
+    # apply the winners: flag-scope persistently (the headline run's
+    # plan builds re-read them), bench-scope via the returned batch/k
+    flag_winners = {n: v for n, v in result.winners.items()
+                    if n in _FLAG_TUNABLES}
+    registry.apply_persistent(flag_winners)
+    batch = int(result.winners.get('train_batch', batch0))
+    k = int(result.winners.get('run_steps_k', k0))
+    info = {'mode': mode, 'cached': result.cached, 'tunables': {}}
+    chosen = dict(base)
+    chosen.update(result.winners)
+    for t in tun:
+        if t.name in result.winners:
+            source = 'tuned'
+        elif registry.is_pinned(t):
+            source = 'pinned'
+        else:
+            source = 'default'
+        info['tunables'][t.name] = {'value': chosen[t.name],
+                                    'source': source}
+    return batch, k, info
+
+
+def main(argv=None):
+    args = _parse_args(argv)
     import jax
     on_tpu = any(d.platform == 'tpu' for d in jax.devices())
     # CPU smoke mode (CI): tiny shapes, still the full train-step path
@@ -37,18 +176,44 @@ def main():
     # bf16 activations (fp32 accumulation + fp32 BN stats) on NHWC — the
     # MXU recipe (SURVEY §6.4); PADDLE_TPU_BENCH_DTYPE/LAYOUT override.
     dtype = os.environ.get('PADDLE_TPU_BENCH_DTYPE', 'bfloat16')
+    if args.tune != 'off':
+        # precision is the amp tunable's job when tuning: build the
+        # pure-f32 program and let the AMP pass cast (the manual bf16
+        # activations plus an AMP rewrite on top would double-cast and
+        # fail IR verification)
+        dtype = 'float32'
     layout = os.environ.get('PADDLE_TPU_BENCH_LAYOUT', 'NHWC')
     stem = os.environ.get('PADDLE_TPU_BENCH_STEM', '7x7')
     image_shape = (hw, hw, 3) if layout == 'NHWC' else (3, hw, hw)
 
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        img, label, prediction, avg_cost, acc = resnet.build_imagenet(
-            depth=depth, num_classes=classes, image_shape=image_shape,
-            dtype=dtype, layout=layout, stem=stem)
-        opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
-                                                momentum=0.9)
-        opt.minimize(avg_cost)
+    def build_prog(b):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            img, label, prediction, avg_cost, acc = \
+                resnet.build_imagenet(
+                    depth=depth, num_classes=classes,
+                    image_shape=image_shape, dtype=dtype,
+                    layout=layout, stem=stem)
+            opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                    momentum=0.9)
+            opt.minimize(avg_cost)
+        del b  # batch rides in the feed (declared dims are -1-batched)
+        return main_prog, startup, avg_cost
+
+    # the per-call dispatch+fetch round trip (~300ms over the tunnel)
+    # amortizes across the scan: K=500 measured 2415-2416 img/s vs 2378
+    # at K=200 (+1.6%), stable spread.  PADDLE_TPU_BENCH_RUN_STEPS
+    # overrides (and pins the run_steps_k tunable)
+    k = int(os.environ.get('PADDLE_TPU_BENCH_RUN_STEPS',
+                           500 if on_tpu else steps))
+
+    tune_info = None
+    if args.tune != 'off':
+        batch, k, tune_info = _autotune(args.tune, build_prog,
+                                        image_shape, classes, batch, k,
+                                        on_tpu)
+
+    main_prog, startup, avg_cost = build_prog(batch)
 
     place = fluid.TPUPlace(0) if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
@@ -105,9 +270,6 @@ def main():
         samples = [batch * steps / dt]
     else:
         staged = next(feeds)
-        k = 500 if on_tpu else steps  # the per-call dispatch+fetch round
-        # trip (~300ms over the tunnel) amortizes: K=500 measured
-        # 2415-2416 img/s vs 2378 at K=200 (+1.6%), stable spread
         out = exe.run_steps(main_prog, feed=staged, fetch_list=[avg_cost],
                             repeat=k, return_numpy=False)  # compile+warm
         np.asarray(out[0])
@@ -184,6 +346,24 @@ def main():
             pass
     result["config"] = "%s %s batch=%d feed=%s" % (dtype, layout, batch,
                                                    feed_mode)
+    if tune_info is not None:
+        result["tune"] = tune_info
+    if args.roofline:
+        cost = (exe.last_graph_opt_report or {}).get('cost')
+        if cost:
+            from paddle_tpu.tuning import roofline as rl
+            rep = rl.report(cost,
+                            measured_step_s=batch / img_per_sec)
+            result["roofline"] = {
+                'floor_s': round(rep['floor_s'], 9),
+                'gap': round(rep.get('gap', 0.0), 3),
+                'mfu': round(rep['mfu'], 4) if 'mfu' in rep else None,
+                'top': [{'type': o['type'], 'index': o['index'],
+                         'role': o.get('role'), 'bound': o['bound'],
+                         'share': round(o.get('share', 0.0), 4)}
+                        for o in rep['top']],
+            }
+            print(rl.format_report(rep), file=sys.stderr)
     if not on_tpu:
         result["note"] = "cpu-smoke (depth=%d hw=%d batch=%d)" % (
             depth, hw, batch)

@@ -1,10 +1,11 @@
 """BASELINE config 1: Fluid MNIST convnet — examples/s."""
 import numpy as np
 
-from common import run_bench, on_tpu
+from common import bench_cli, run_bench, on_tpu
 
 
 def main():
+    opts = bench_cli()
     import paddle_tpu as fluid
     from paddle_tpu.models import mnist
 
@@ -37,7 +38,8 @@ def main():
               note='batch=%d' % batch,
               compile_stats=True,
               amp_compare='bf16',
-              step_breakdown=True)
+              step_breakdown=True,
+              tune=opts.tune, roofline=opts.roofline)
 
 
 if __name__ == '__main__':

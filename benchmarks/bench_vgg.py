@@ -4,10 +4,12 @@ import argparse
 
 import numpy as np
 
-from common import ensure_mesh_devices, mesh_bench, run_bench, on_tpu
+from common import (bench_cli, ensure_mesh_devices, mesh_bench,
+                    run_bench, on_tpu)
 
 
 def main(argv=None):
+    opts = bench_cli(argv)  # --tune / --roofline / --tune-trace
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--mesh', action='append', default=None,
                     metavar='SPEC',
@@ -15,6 +17,13 @@ def main(argv=None):
                          "PADDLE_TPU_MESH spec (repeatable, e.g. "
                          "--mesh off --mesh dp=2 --mesh dp=4); forces "
                          "virtual host devices on CPU")
+    ap.add_argument('--tune', choices=('off', 'cached', 'search'),
+                    default=opts.tune, help='autotuner mode (common.'
+                    'bench_cli); winners apply to the non-mesh rows')
+    ap.add_argument('--roofline', action='store_true',
+                    default=opts.roofline,
+                    help='attach the top-ops roofline report per row')
+    ap.add_argument('--tune-trace', action='store_true')
     args = ap.parse_args(argv)
     if args.mesh:
         # must precede the first jax import (device count freezes)
@@ -69,7 +78,8 @@ def main(argv=None):
               steps=40 if on_tpu() else 3,  # K=40: +8% vs K=10 (dispatch)
               note='batch=%d hw=%d NHWC' % (batch, hw),
               dtype='bfloat16',
-              step_breakdown=True)
+              step_breakdown=True,
+              tune=args.tune, roofline=args.roofline)
     # f32 build through the AMP pass: amp=off is the true f32 baseline,
     # amp=bf16 should match the manual-cast headline above
     run_bench('vgg16_train_img_per_sec', batch,

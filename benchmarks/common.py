@@ -13,6 +13,120 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def bench_cli(argv=None):
+    """Shared bench flags: ``--tune {off,cached,search}``,
+    ``--roofline``, ``--tune-trace``.  Unknown args pass through so
+    benches with their own parsers compose (parse_known_args).  The
+    defaults honour PADDLE_TPU_TUNE, so ``run_all.py`` children and a
+    bare ``python bench_x.py`` under an env opt-in behave alike."""
+    import argparse
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument('--tune', choices=('off', 'cached', 'search'),
+                   default=os.environ.get('PADDLE_TPU_TUNE') or 'off')
+    p.add_argument('--roofline', action='store_true')
+    p.add_argument('--tune-trace', action='store_true')
+    args, _rest = p.parse_known_args(argv)
+    if args.tune_trace:
+        os.environ['PADDLE_TPU_TUNE_TRACE'] = '1'
+    return args
+
+
+# flag-scope tunables the generic bench driver searches for a fixed
+# program (batch/K live in bench.py, which rebuilds per candidate)
+_BENCH_TUNABLES = ('amp', 'flat_tile_budget', 'device_prefetch_chunk')
+
+
+def _tune_bench(build, feed_fn, mode, tunables=_BENCH_TUNABLES):
+    """Search (or cache-load) tuner winners for one bench program.
+
+    Returns ``(overrides, info)``: env overrides to apply around the
+    measured run, and the RESULTS-row attribution dict recording which
+    tunables were tuner-chosen vs defaults vs user-pinned."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.tuning import (cache as tcache, registry,
+                                   runtime as trt, search as tsearch)
+
+    program, startup, loss = build()
+    feed_specs = {k: (tuple(np.asarray(v).shape),
+                      str(np.asarray(v).dtype))
+                  for k, v in feed_fn().items()}
+    key = trt.cache_key_for(program)
+    tun = [registry.tunable(n) for n in tunables]
+
+    def model_fn(cfg):
+        with registry.applied(cfg):
+            return trt.model_program(program,
+                                     fetch_names=(loss.name,),
+                                     feed_specs=feed_specs)
+
+    k = 40 if on_tpu() else 4
+
+    def measure_fn(cfg):
+        # short measured run per surviving candidate: fresh scope +
+        # executor under the candidate env, one warm run_steps chain,
+        # one timed — the per-phase walls land in last_step_report via
+        # the same path the flight recorder instruments
+        with registry.applied(cfg):
+            scope = fluid.core.scope.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.TPUPlace(0) if on_tpu()
+                                     else fluid.CPUPlace())
+                exe.run(startup)
+                feed = feed_fn()
+                out = exe.run_steps(program, feed=feed,
+                                    fetch_list=[loss], repeat=k,
+                                    return_numpy=False)
+                jax.block_until_ready(out[0])
+                t0 = time.perf_counter()
+                out = exe.run_steps(program, feed=feed,
+                                    fetch_list=[loss], repeat=k,
+                                    return_numpy=False)
+                jax.block_until_ready(out[0])
+                return (time.perf_counter() - t0) / k
+
+    result = tsearch.autotune(model_fn, measure_fn, tunables=tun,
+                              cache=tcache.TuneCache(), cache_key=key,
+                              mode=mode)
+    if result is None:
+        return {}, None
+    if FLAGS.tune_trace:
+        print(result.format_trace(), file=sys.stderr)
+    current = registry.current_config(tun)
+    info = {'mode': mode, 'cached': result.cached, 'tunables': {}}
+    for t in tun:
+        if t.name in result.winners:
+            value, source = result.winners[t.name], 'tuned'
+        elif registry.is_pinned(t):
+            value, source = current[t.name], 'pinned'
+        else:
+            value, source = t.default, 'default'
+        info['tunables'][t.name] = {'value': value, 'source': source}
+    return dict(result.winners), info
+
+
+def _maybe_roofline(result, exe, unit_count):
+    """Attach the --roofline report to a result row (and print the
+    human-readable top-ops lines to stderr)."""
+    from paddle_tpu.tuning import roofline as rl
+    cost = (exe.last_graph_opt_report or {}).get('cost')
+    if not cost or not result.get('value'):
+        return
+    step_s = unit_count / result['value']
+    rep = rl.report(cost, measured_step_s=step_s)
+    result['roofline'] = {
+        'floor_s': round(rep['floor_s'], 9),
+        'gap': round(rep.get('gap', 0.0), 3),
+        'mfu': round(rep['mfu'], 4) if 'mfu' in rep else None,
+        'top': [{'type': o['type'], 'index': o['index'],
+                 'role': o.get('role'), 'bound': o['bound'],
+                 'share': round(o.get('share', 0.0), 4)}
+                for o in rep['top']],
+    }
+    print(rl.format_report(rep), file=sys.stderr)
+
+
 def maybe_force_cpu():
     """Honour a CPU-smoke request via the config API: the bench box's
     sitecustomize re-registers the TPU tunnel plugin and clears
@@ -174,7 +288,8 @@ def mesh_bench(metric, unit_count, build, feed_fn, mesh_specs,
 
 def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
               note=None, dtype=None, compile_stats=False,
-              amp_compare=None, step_breakdown=False):
+              amp_compare=None, step_breakdown=False, tune='off',
+              roofline=False):
     """build() -> (program, startup, loss_var); feed_fn() -> feed dict.
     unit_count = units (imgs/tokens/examples) per step.
 
@@ -198,24 +313,38 @@ def run_bench(metric, unit_count, build, feed_fn, steps=20, warmup=3,
     write-back — measured twice, PADDLE_TPU_DEVICE_PREFETCH off and
     on, so the feed column visibly collapses to the pipeline prime
     when staging overlaps execution."""
-    if amp_compare:
-        import paddle_tpu as fluid
-        from paddle_tpu.transpiler.amp import amp_guard
-        results = []
-        for mode in ('0', amp_compare):
-            label = 'off' if mode == '0' else mode
-            scope = fluid.core.scope.Scope()
-            with amp_guard(mode), fluid.scope_guard(scope):
-                results.append(_bench_once(
-                    metric, unit_count, build, feed_fn, steps=steps,
-                    warmup=warmup, note=note, dtype=dtype,
-                    compile_stats=compile_stats, _amp_label=label,
-                    step_breakdown=step_breakdown))
-        return results
-    return _bench_once(metric, unit_count, build, feed_fn, steps=steps,
-                       warmup=warmup, note=note, dtype=dtype,
-                       compile_stats=compile_stats,
-                       step_breakdown=step_breakdown)
+    import contextlib
+    overrides, tune_info = {}, None
+    guard = contextlib.nullcontext()
+    if tune and tune != 'off':
+        # search/load winners first, then run the whole measurement
+        # under the winning env overrides (every consumer re-reads its
+        # flag per plan build, so the overrides just take effect)
+        from paddle_tpu.tuning import registry as _treg
+        overrides, tune_info = _tune_bench(build, feed_fn, tune)
+        guard = _treg.applied(overrides)
+    with guard:
+        if amp_compare:
+            import paddle_tpu as fluid
+            from paddle_tpu.transpiler.amp import amp_guard
+            results = []
+            for mode in ('0', amp_compare):
+                label = 'off' if mode == '0' else mode
+                scope = fluid.core.scope.Scope()
+                with amp_guard(mode), fluid.scope_guard(scope):
+                    results.append(_bench_once(
+                        metric, unit_count, build, feed_fn,
+                        steps=steps, warmup=warmup, note=note,
+                        dtype=dtype, compile_stats=compile_stats,
+                        _amp_label=label,
+                        step_breakdown=step_breakdown,
+                        roofline=roofline, tune_info=tune_info))
+            return results
+        return _bench_once(metric, unit_count, build, feed_fn,
+                           steps=steps, warmup=warmup, note=note,
+                           dtype=dtype, compile_stats=compile_stats,
+                           step_breakdown=step_breakdown,
+                           roofline=roofline, tune_info=tune_info)
 
 
 def _step_breakdown(exe, program, loss, feed_fn, k=None, chunk=2):
@@ -319,7 +448,8 @@ def _step_breakdown(exe, program, loss, feed_fn, k=None, chunk=2):
 
 def _bench_once(metric, unit_count, build, feed_fn, steps=20, warmup=3,
                 note=None, dtype=None, compile_stats=False,
-                _amp_label=None, step_breakdown=False):
+                _amp_label=None, step_breakdown=False, roofline=False,
+                tune_info=None):
     import jax
     import paddle_tpu as fluid
 
@@ -414,5 +544,11 @@ def _bench_once(metric, unit_count, build, feed_fn, steps=20, warmup=3,
         result["dtype"] = dtype
     if note:
         result["note"] = note
+    if tune_info is not None:
+        # which tunables were tuner-chosen vs defaults vs user-pinned —
+        # the attribution record that makes BENCH r06 explainable
+        result["tune"] = tune_info
+    if roofline:
+        _maybe_roofline(result, exe, unit_count)
     print(json.dumps(result))
     return result

@@ -12,10 +12,14 @@ BENCHES = ['bench_mnist.py', 'bench_vgg.py', 'bench_lstm_lm.py',
            'bench_feed.py']
 
 if __name__ == '__main__':
+    # forward the shared bench flags (--tune {off,cached,search},
+    # --roofline, --tune-trace) to every child; benches parse them via
+    # common.bench_cli (parse_known_args — unknown flags pass through)
+    extra = sys.argv[1:]
     failed = []
     for b in BENCHES:
-        r = subprocess.run([sys.executable, os.path.join(HERE, b)],
-                           cwd=HERE)
+        r = subprocess.run([sys.executable, os.path.join(HERE, b)]
+                           + extra, cwd=HERE)
         if r.returncode != 0:
             failed.append(b)
     if failed:

@@ -10,6 +10,7 @@ across steps, so a full train step (forward + backward + optimizer update)
 is one device launch with zero host round-trips.
 """
 import contextlib
+import os
 import re
 import time
 import warnings
@@ -33,6 +34,7 @@ __all__ = ['Executor', 'global_scope', 'scope_guard']
 from .scope import scope_guard  # re-export (parity with fluid.executor)
 
 _compilation_cache_dir = None  # last dir applied to jax.config
+_compilation_cache_resolved = False  # any resolve happened (late-apply)
 
 
 def _maybe_enable_compilation_cache():
@@ -42,12 +44,19 @@ def _maybe_enable_compilation_cache():
     process restarts, so a restarted server skips straight to cache hits.
     Re-reads the flag each call (cheap) so tests and long-lived drivers
     can flip it; thresholds drop to 0 so even fast CPU-smoke compiles
-    persist (the default 1s floor would skip them silently)."""
-    global _compilation_cache_dir
+    persist (the default 1s floor would skip them silently).
+
+    Called from executor/server construction AND from every plan-cache
+    miss, so a dir set after first executor use applies on the next
+    plan build (with a one-line warning) instead of silently waiting
+    for reset_cache()."""
+    global _compilation_cache_dir, _compilation_cache_resolved
     from ..flags import FLAGS
     d = FLAGS.compilation_cache_dir or None
     if d == _compilation_cache_dir:
+        _compilation_cache_resolved = True
         return
+    late = _compilation_cache_resolved and d is not None
     try:
         jax.config.update('jax_compilation_cache_dir', d)
         if d:
@@ -64,6 +73,31 @@ def _maybe_enable_compilation_cache():
     except Exception:  # pragma: no cover - older jax without the knobs
         return
     _compilation_cache_dir = d
+    _compilation_cache_resolved = True
+    if late:
+        import logging
+        logging.getLogger(__name__).warning(
+            'PADDLE_TPU_COMPILATION_CACHE_DIR=%r was set after first '
+            'executor use; applied now — plans built from here on '
+            'compile into the persistent cache', d)
+
+
+def _maybe_apply_tuned(program, place):
+    """PADDLE_TPU_TUNE=cached: apply persisted autotuner winners for
+    this program (tuning/runtime.py) BEFORE the mesh resolves and the
+    plan key is computed — the applied env overrides are plan-cache-key
+    components, so the tuned plan builds exactly as a fresh pre-tuned
+    process would build it.  With tuning off (the default) this is one
+    dict lookup: no import, no flag object, bitwise-identical paths."""
+    if os.environ.get('PADDLE_TPU_TUNE') != 'cached':
+        return
+    try:
+        from ..tuning import runtime as _trt
+        _trt.maybe_apply_cached(program, place)
+    except Exception:  # never let tuning break an untunable run
+        import logging
+        logging.getLogger(__name__).warning(
+            'tuning cache apply failed; running untuned', exc_info=True)
 
 
 class _ExecutorMetrics(object):
@@ -744,6 +778,11 @@ class Executor(object):
 
         block = program.global_block()
 
+        # PADDLE_TPU_TUNE=cached: persisted tuner winners apply here,
+        # before mesh resolution and plan-key computation (one dict
+        # lookup when tuning is off)
+        _maybe_apply_tuned(program, self.place)
+
         # flight recorder (observability/timeline.py): one cached-bool
         # check when disarmed, phase events on the shared ring when
         # PADDLE_TPU_TRACE_DIR / _TRACE_DUMP_ON_ERROR armed it
@@ -1147,6 +1186,10 @@ class Executor(object):
         self._plan_fresh = True
         if _obs.enabled():
             _em().plan_cache_misses.inc()
+        # a compilation-cache dir set after construction applies to THIS
+        # build (one-line warning inside) instead of silently waiting
+        # for reset_cache()
+        _maybe_enable_compilation_cache()
 
         known = set()
         for b in program.blocks:
@@ -1333,6 +1376,9 @@ class Executor(object):
                                ["is missing %s" % missing if missing
                                 else '',
                                 "adds %s" % extra if extra else '']))))
+
+        # tuned winners, like run(): before mesh and plan key resolve
+        _maybe_apply_tuned(program, self.place)
 
         mesh, dev = self._mesh_and_dev(program)
         spmd = self._spmd_mesh(program) if mesh is None else None

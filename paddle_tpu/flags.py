@@ -373,6 +373,68 @@ DEFINE_bool('lock_debug', False,
             'primitives: zero added cost, the PR-2 cached-bool '
             'contract.  Read when a lock is CREATED, so flips apply '
             'to servers/fleets/controllers constructed afterwards')
+DEFINE_string('tune', 'off',
+              'feedback-directed autotuner (paddle_tpu.tuning): "off" '
+              '(default) is bitwise the untuned framework — one env '
+              'read per executor call, nothing imported; "cached" makes '
+              'the executor apply persisted tuner winners for a program '
+              '(keyed by plan key + device kind + mesh, from '
+              'PADDLE_TPU_TUNE_CACHE_DIR) before its plan builds, so a '
+              'fresh process starts tuned with zero search; "search" is '
+              'consumed by the bench harness (bench.py --tune search) '
+              'to run the cost-model-pruned measured search and persist '
+              'the winners.  The executor itself never searches')
+DEFINE_string('tune_cache_dir', '',
+              'where tuner winners persist (JSON, one file per '
+              '(plan key, device kind, mesh) under a paddle_tpu_tuning/ '
+              'subdir).  Empty falls back to '
+              'PADDLE_TPU_COMPILATION_CACHE_DIR; empty too means no '
+              'persistence (search results live only in-process).  A '
+              'corrupted cache file is counted '
+              '(paddle_tpu_tune_cache_corrupt_total) and ignored — '
+              'defaults apply, nothing crashes')
+DEFINE_bool('tune_trace', False,
+            'print the autotuner search trace (one line per candidate: '
+            'modeled score, measured score, pruned/measured/adopted '
+            'and why) to stderr after a bench-driven search — the '
+            'attribution record BENCH rows cite')
+DEFINE_int('tune_measure_budget', 24,
+           'max candidates the autotuner MEASURES per search (pruned '
+           'candidates are free; past the budget remaining candidates '
+           'are pruned as measure-budget).  Bounds bench wall time on '
+           'slow backends')
+DEFINE_int('flat_tile_budget', 0,
+           'per-block VMEM budget in bytes for the Pallas dense-apply '
+           'flat tile chooser (ops/pallas/dense_update.pick_flat_tile): '
+           '0 (default) keeps the baked-in 4 MiB; the autotuner '
+           'searches {1,2,4,8,16} MiB through this override.  Read at '
+           'trace time and part of the composite plan-cache key, so a '
+           'flip retraces instead of serving a stale tile size')
+DEFINE_float('serving_max_wait_ms', 5.0,
+             'default deadline flush for BatchingInferenceServer when '
+             'the constructor is not passed max_wait_ms= explicitly: '
+             'how long the oldest queued request may wait before a '
+             'partial batch dispatches anyway.  A registered tunable '
+             '(tuning/registry.py) the serving benches can search')
+DEFINE_int('serving_max_batch', 8,
+           'default bucket-ladder top for export_bucketed / '
+           'BatchingInferenceServer.from_program when max_batch= is '
+           'not passed explicitly: buckets are powers of two up to '
+           'this many rows.  A registered tunable the serving benches '
+           'can search')
+DEFINE_float('peak_tflops', 0.0,
+             'device peak TFLOP/s for MFU and roofline accounting '
+             '(bench.py, benchmarks/common.py, tuning/roofline.py): '
+             '0 (default) makes the roofline model fall back to 192 '
+             '(the measured sustained square-matmul peak PERF.md '
+             'calibrated) while bench MFU columns stay absent unless '
+             'the env var is set — the pre-existing contract')
+DEFINE_float('hbm_gbps', 0.0,
+             'modeled HBM bandwidth in GB/s for the roofline model '
+             '(tuning/roofline.py): the bytes-bound op floor is '
+             'bytes / this.  0 (default) falls back to 819 GB/s '
+             '(v5e HBM).  Only affects modeled numbers — reports, '
+             'priors, pruning — never measured ones')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -189,7 +189,7 @@ def bucket_sizes(max_batch):
 
 
 def export_bucketed(dir_path, feed_specs, target_vars, executor=None,
-                    main_program=None, scope=None, max_batch=8,
+                    main_program=None, scope=None, max_batch=None,
                     amp=None):
     """Export one shape-specialized StableHLO artifact per bucket size.
 
@@ -208,6 +208,11 @@ def export_bucketed(dir_path, feed_specs, target_vars, executor=None,
         :class:`BatchingInferenceServer`.
     """
     from ..transpiler.amp import amp_guard
+    if max_batch is None:
+        # registered tunable: flag default 8 keeps the historical
+        # ladder when the env is unset; explicit max_batch= still wins
+        from ..flags import FLAGS
+        max_batch = int(FLAGS.serving_max_batch)
     paths = {}
     with amp_guard(amp):
         for b in bucket_sizes(max_batch):
@@ -254,9 +259,15 @@ class BatchingInferenceServer(object):
     unbounded memory).
     """
 
-    def __init__(self, bucket_paths, max_wait_ms=5.0, linger_ms=0.5,
+    def __init__(self, bucket_paths, max_wait_ms=None, linger_ms=0.5,
                  max_queue=4096, warmup=True, latency_window=4096,
                  share_artifacts_with=None, warmup_throttle_ms=0.0):
+        if max_wait_ms is None:
+            # registered tunable (tuning/registry.py): the flag default
+            # is the historical 5.0 ms, so an unset env is bitwise the
+            # old constructor default; explicit max_wait_ms= still wins
+            from ..flags import FLAGS
+            max_wait_ms = float(FLAGS.serving_max_wait_ms)
         _maybe_enable_compilation_cache()
         if share_artifacts_with is not None:
             # a sibling server over the SAME model version: reuse its
@@ -393,7 +404,7 @@ class BatchingInferenceServer(object):
 
     @classmethod
     def from_program(cls, feed_specs, target_vars, executor=None,
-                     main_program=None, scope=None, max_batch=8,
+                     main_program=None, scope=None, max_batch=None,
                      path_dir=None, **kw):
         """Export the bucket ladder for a program and serve it, in one
         call.  ``feed_specs`` are per-request example shapes (no batch

@@ -52,7 +52,7 @@ from jax.experimental import pallas as pl
 from ._compat import CompilerParams as _CompilerParams
 
 __all__ = ['dense_apply_sgd', 'dense_apply_momentum', 'dense_apply_adam',
-           'dense_apply_mode', 'pick_flat_tile']
+           'dense_apply_mode', 'pick_flat_tile', 'flat_tile_budget']
 
 # per-block VMEM the flat walk may claim: tables are double-buffered by
 # Mosaic (in + aliased out), values single; leave margin for temporaries
@@ -76,6 +76,18 @@ def dense_apply_mode():
     return 'pallas' if jax.default_backend() == 'tpu' else 'xla'
 
 
+def flat_tile_budget():
+    """Resolved per-block VMEM budget for :func:`pick_flat_tile`:
+    PADDLE_TPU_FLAT_TILE_BUDGET when >0 (the autotuner's hook — a
+    registered tunable in tuning/registry.py), the baked-in 4 MiB
+    otherwise.  Read at trace time and a component of the composite
+    plan-cache key (pass_manager.plan_key), so an override retraces
+    instead of serving a plan built at the old tile size."""
+    from ...flags import FLAGS
+    b = int(FLAGS.flat_tile_budget or 0)
+    return b if b > 0 else _VMEM_BUDGET
+
+
 def pick_flat_tile(n, n_tables, n_vals, budget=None):
     """Largest lane-aligned tile T such that one grid step's working
     set — each table twice (block in + aliased block out) + each value
@@ -85,7 +97,7 @@ def pick_flat_tile(n, n_tables, n_vals, budget=None):
     tile, never veto the kernel (same contract as
     lstm_cell.pick_batch_tile returning its smallest divisor)."""
     if budget is None:
-        budget = _VMEM_BUDGET
+        budget = flat_tile_budget()
     bufs = 2 * n_tables + n_vals
     padded = -(-max(int(n), 1) // 128) * 128
     for t in _TILES:

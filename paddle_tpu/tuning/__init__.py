@@ -1,0 +1,33 @@
+"""Plan-build-time autotuner (ISSUE 16): search every hand-set
+tunable with static-cost-model priors and measured feedback.
+
+- :mod:`registry` — the central tunable registry (name, bounded
+  domain, default, subsystem, env override; pin by setting the env
+  var yourself).
+- :mod:`search` — cost-model-pruned greedy search
+  (:class:`Autotuner`, :func:`autotune`).
+- :mod:`cache` — winners persist in the compile-cache dir keyed by
+  plan key + device kind + mesh (:class:`TuneCache`); corrupted files
+  fall back to defaults, counted.
+- :mod:`roofline` — modeled step-time floors and the ``--roofline``
+  top-ops report.
+- :mod:`runtime` — executor glue: ``PADDLE_TPU_TUNE=cached`` applies
+  persisted winners before the plan key is computed, so a fresh
+  process starts tuned with zero search.
+
+``PADDLE_TPU_TUNE=off`` (default) keeps every executor path bitwise
+identical to the untuned framework: one env read, nothing imported.
+"""
+from . import registry  # noqa: F401  (registrations run at import)
+from .cache import TuneCache  # noqa: F401
+from .registry import (Tunable, register_tunable,  # noqa: F401
+                       registered_tunables)
+from .roofline import modeled_step_s, report  # noqa: F401
+from .runtime import (base_plan_key, cache_key_for,  # noqa: F401
+                      maybe_apply_cached, model_program)
+from .search import Autotuner, SearchResult, autotune  # noqa: F401
+
+__all__ = ['Tunable', 'register_tunable', 'registered_tunables',
+           'TuneCache', 'Autotuner', 'SearchResult', 'autotune',
+           'modeled_step_s', 'report', 'base_plan_key',
+           'cache_key_for', 'maybe_apply_cached', 'model_program']

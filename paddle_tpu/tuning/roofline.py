@@ -1,0 +1,152 @@
+"""Roofline model over the static cost report.
+
+Turns transpiler/cost_model.py's per-op FLOPs/bytes into modeled time
+floors: op floor = max(flops / peak_flops, bytes / hbm_bw), the op's
+limiting resource is whichever term wins ('mxu' vs 'hbm'), and the
+program floor is the sum (serial-op approximation — XLA overlaps some
+of this, so the floor is optimistic; the gap ratio absorbs the
+difference).  Two consumers:
+
+- the autotuner's prior: ``modeled_step_s`` scores candidates before
+  anything is measured, so modeled-worse configs are pruned for free;
+- the ``--roofline`` bench report: the top-N ops furthest off the
+  roofline (largest modeled share of a measured gap) with their
+  limiting resource — where the next millisecond lives.
+
+Resources resolve from flags: PADDLE_TPU_PEAK_TFLOPS (fallback 192, the
+sustained square-matmul peak PERF.md calibrated), PADDLE_TPU_HBM_GBPS
+(fallback 819, v5e HBM), PADDLE_TPU_ICI_GBPS for the collective term
+(0 = bytes only, no modeled seconds — the existing contract).
+"""
+
+__all__ = ['resolved_peak_tflops', 'resolved_hbm_gbps',
+           'modeled_step_s', 'report', 'format_report']
+
+DEFAULT_PEAK_TFLOPS = 192.0  # measured sustained matmul peak (PERF.md)
+DEFAULT_HBM_GBPS = 819.0     # v5e HBM bandwidth
+
+
+def resolved_peak_tflops():
+    from ..flags import FLAGS
+    v = float(FLAGS.peak_tflops or 0.0)
+    return v if v > 0 else DEFAULT_PEAK_TFLOPS
+
+
+def resolved_hbm_gbps():
+    from ..flags import FLAGS
+    v = float(FLAGS.hbm_gbps or 0.0)
+    return v if v > 0 else DEFAULT_HBM_GBPS
+
+
+def _resources(peak_tflops, hbm_gbps):
+    peak = peak_tflops if peak_tflops else resolved_peak_tflops()
+    hbm = hbm_gbps if hbm_gbps else resolved_hbm_gbps()
+    return float(peak) * 1e12, float(hbm) * 1e9
+
+
+def _collective_s(cost, ici_gbps=None):
+    coll = cost.get('collectives') or {}
+    ici_bytes = coll.get('ici_bytes') or 0
+    if ici_gbps is None:
+        from ..flags import FLAGS
+        ici_gbps = float(FLAGS.ici_gbps or 0.0)
+    if ici_bytes and ici_gbps > 0:
+        return ici_bytes / (ici_gbps * 1e9)
+    return 0.0
+
+
+def modeled_step_s(cost, peak_tflops=None, hbm_gbps=None, ici_gbps=None):
+    """Modeled step-time floor for a whole cost report: whole-program
+    max(flops/peak, bytes/bw) plus the modeled collective term.  The
+    autotuner's candidate-scoring prior — cheap, deterministic, and
+    monotone in what the search cares about."""
+    total = cost.get('total') or {}
+    flops = total.get('flops') or 0
+    nbytes = total.get('bytes') or 0
+    peak_fs, hbm_bs = _resources(peak_tflops, hbm_gbps)
+    return max(flops / peak_fs, nbytes / hbm_bs) + \
+        _collective_s(cost, ici_gbps)
+
+
+def report(cost, measured_step_s=None, peak_tflops=None, hbm_gbps=None,
+           ici_gbps=None, top=3):
+    """Roofline report dict for one cost report.
+
+    Per-op floors rank the ops; with a measured step time the gap ratio
+    (measured / floor) attributes the lost time proportionally to each
+    op's modeled floor (``lost_s``) — with no per-op measurement the
+    ops with the largest modeled share are where the gap concentrates
+    under the uniform-slowdown assumption ``basis`` states."""
+    peak_fs, hbm_bs = _resources(peak_tflops, hbm_gbps)
+    ops = []
+    for e in cost.get('per_op') or ():
+        flops = e.get('flops') or 0
+        nbytes = e.get('bytes') or 0
+        t_mxu = flops / peak_fs
+        t_hbm = nbytes / hbm_bs
+        floor = max(t_mxu, t_hbm)
+        if floor <= 0:
+            continue
+        ops.append({
+            'index': e.get('index'),
+            'type': e.get('type'),
+            'role': e.get('role'),
+            'floor_s': floor,
+            'bound': 'mxu' if t_mxu >= t_hbm else 'hbm',
+            'flops': flops,
+            'bytes': nbytes,
+        })
+    op_floor = sum(o['floor_s'] for o in ops)
+    coll_s = _collective_s(cost, ici_gbps)
+    floor = op_floor + coll_s
+    ops.sort(key=lambda o: (-o['floor_s'], o['index'] or 0))
+    rep = {
+        'floor_s': floor,
+        'collective_s': coll_s,
+        'peak_tflops': peak_fs / 1e12,
+        'hbm_gbps': hbm_bs / 1e9,
+        'op_count': len(ops),
+        'top': ops[:max(int(top), 0)],
+        'basis': ('per-op floor = max(flops/peak, bytes/hbm_bw), '
+                  'program floor = sum of op floors (+ modeled '
+                  'collective); measured gap attributed to ops in '
+                  'proportion to their modeled floor'),
+    }
+    if floor > 0:
+        for o in rep['top']:
+            o['share'] = o['floor_s'] / floor
+    if measured_step_s is not None and floor > 0:
+        gap = measured_step_s / floor
+        rep['measured_step_s'] = measured_step_s
+        rep['gap'] = gap
+        total_flops = (cost.get('total') or {}).get('flops') or 0
+        if measured_step_s > 0:
+            rep['mfu'] = total_flops / (measured_step_s * peak_fs)
+        for o in rep['top']:
+            o['lost_s'] = o['floor_s'] * max(gap - 1.0, 0.0)
+    return rep
+
+
+def format_report(rep):
+    """Human-readable lines for the --roofline bench output."""
+    lines = []
+    head = ('roofline: floor %.3gms' % (rep['floor_s'] * 1e3))
+    if 'measured_step_s' in rep:
+        head += (', measured %.3gms (%.2fx off roofline'
+                 % (rep['measured_step_s'] * 1e3, rep['gap']))
+        if 'mfu' in rep:
+            head += ', mfu %.3f' % rep['mfu']
+        head += ')'
+    head += (' [peak %g TFLOP/s, hbm %g GB/s]'
+             % (rep['peak_tflops'], rep['hbm_gbps']))
+    lines.append(head)
+    for i, o in enumerate(rep['top'], 1):
+        row = ('  #%d %s (op %s, %s): floor %.3gms, %.1f%% of program, '
+               '%s-bound'
+               % (i, o['type'], o['index'], o.get('role') or '?',
+                  o['floor_s'] * 1e3, 100.0 * o.get('share', 0.0),
+                  o['bound']))
+        if 'lost_s' in o:
+            row += ', ~%.3gms of the gap' % (o['lost_s'] * 1e3)
+        lines.append(row)
+    return '\n'.join(lines)
