@@ -208,6 +208,33 @@ DEFINE_float('fleet_drain_timeout_s', 30.0,
              'before the fleet closes it anyway — bounds how long '
              'remove_replica(), deploy() old-version retirement, and '
              'fleet.close() can block on a stuck replica')
+DEFINE_string('fleet_hbm_admission', 'warn',
+              "ServingFleet HBM budget mode.  'warn' (default): an "
+              'over-budget deploy() is logged and counted but '
+              'proceeds (the PR-10 precheck behavior).  '
+              "'enforce': the budget manager first LRU-evicts cold "
+              'tenants\' compiled buckets to make room and, when the '
+              'projection still does not fit, rejects the deploy with '
+              'a typed tenancy.AdmissionError BEFORE any replica '
+              'build cost is paid')
+DEFINE_int('fleet_tenant_quota', 0,
+           'base outstanding-request quota per fleet tenant, scaled '
+           'by SLO-class weight (gold keeps the full base, silver '
+           'base/2, bronze base/8, min 1).  A tenant at its quota has '
+           'further submits parked on a per-tenant queue and drained '
+           'in SLO-weighted round-robin order as slots free up — '
+           'deferred, never dropped.  0 (default) disables quota '
+           'gating entirely')
+DEFINE_string('aot_cache_dir', '',
+              'root directory of the serving AOT-executable cache '
+              '(entries live under <dir>/paddle_tpu_aot).  Each '
+              'warmed bucket\'s compiled executable is serialized '
+              'there (jax serialize_executable) so a brand-new '
+              'PROCESS deploys by deserializing instead of '
+              'trace+compile: zero warmup compiles on a warm cache.  '
+              'Point it at PADDLE_TPU_COMPILATION_CACHE_DIR to keep '
+              'the serialized executables next to the XLA compile '
+              'cache.  Empty (default) disables AOT serialization')
 DEFINE_string('verify_ir', 'boundary',
               'static program verifier over the pass-manager rewrite '
               'pipeline (transpiler/verify.py): "boundary" (default) '

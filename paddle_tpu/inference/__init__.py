@@ -2,7 +2,10 @@ from .serving import export_inference, load_exported, InferenceServer
 from .batching import (BatchingInferenceServer, bucket_sizes,
                        export_bucketed)
 from .fleet import ServingFleet
+from .aot_cache import AotCache
+from .tenancy import AdmissionError, TenantRegistry, SLO_CLASSES
 
 __all__ = ['export_inference', 'load_exported', 'InferenceServer',
            'BatchingInferenceServer', 'export_bucketed', 'bucket_sizes',
-           'ServingFleet']
+           'ServingFleet', 'AotCache', 'AdmissionError',
+           'TenantRegistry', 'SLO_CLASSES']

@@ -58,6 +58,7 @@ from .. import observability as _obs
 from ..analysis import lockdebug as _lkd
 from ..core.executor import _maybe_enable_compilation_cache
 from ..observability import timeline as _tlm
+from .aot_cache import AotCache, artifact_digest
 from .serving import InferenceServer, export_inference
 
 __all__ = ['BatchingInferenceServer', 'export_bucketed', 'bucket_sizes']
@@ -302,6 +303,14 @@ class BatchingInferenceServer(object):
             self._feed_names = src._feed_names
             self._example_shapes = src._example_shapes
             self._dtypes = src._dtypes
+            # eviction/AOT state is part of the shared servable: a
+            # bucket evicted or re-warmed through either sibling is
+            # evicted/re-warmed for both, and the last-use map feeds
+            # the budget manager's LRU with dispatches from all lanes
+            self._aot = src._aot
+            self._aot_digests = src._aot_digests
+            self._bucket_used = src._bucket_used
+            self._res_gen = src._res_gen
         else:
             if not bucket_paths:
                 raise ValueError("bucket_paths is empty")
@@ -329,6 +338,25 @@ class BatchingInferenceServer(object):
                         "ladder (expected %s): every bucket must "
                         "export the same example shapes with only the "
                         "batch axis varying" % (b, got, want))
+            # AOT executable cache (PADDLE_TPU_AOT_CACHE_DIR): warmup
+            # deserializes stored executables instead of compiling —
+            # zero warmup compiles on a warm disk cache.  Disabled
+            # (the default) this is one flag read and None forever.
+            aot = AotCache()
+            self._aot = aot if aot.enabled() else None
+            self._aot_digests = {}  # bucket -> artifact sha1
+            # per-bucket last-dispatch stamps (time.monotonic), the
+            # budget manager's LRU signal.  Written by the dispatcher
+            # thread only; readers (the fleet's eviction planner)
+            # tolerate a stale read — like _compiled, the dict itself
+            # is GIL-atomic and never locked.
+            self._bucket_used = {}
+            # residency generation, bumped on evict and on post-warmup
+            # (re)compiles so fleet replicas know their cached
+            # resident_bytes() snapshot went stale.  One shared
+            # mutable cell: siblings sharing this servable must see
+            # the same generation.
+            self._res_gen = [0]
         self.max_wait = float(max_wait_ms) / 1e3
         self.linger = float(linger_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -584,8 +612,11 @@ class BatchingInferenceServer(object):
         total = 0
         for b in self._buckets:
             e = {'compiled': b in self._compiled}
+            # artifact bytes count only while the bucket's artifact is
+            # actually loaded (an evicted bucket keeps its file on
+            # disk but holds nothing resident)
             p = self._bucket_paths.get(b)
-            if p:
+            if p and b in self._servers:
                 try:
                     e['artifact_bytes'] = os.path.getsize(p)
                 except OSError:
@@ -723,25 +754,97 @@ class BatchingInferenceServer(object):
         return bucket, stacked, offsets
 
     # -- compile management --------------------------------------------
+    def _aot_key(self, bucket):
+        """This bucket's AOT-cache key: the artifact's content digest
+        (standing in for the composite plan key — the exported module
+        embeds the pass pipeline's output and the baked params) +
+        bucket + device kind + jax version.  Digests memoize per
+        bucket and are shared across sibling servers."""
+        digest = self._aot_digests.get(bucket)
+        if digest is None:
+            digest = artifact_digest(self._bucket_paths[bucket])
+            self._aot_digests[bucket] = digest
+        return self._aot.key(digest, bucket)
+
     def _ensure_compiled(self, bucket):
         """AOT-compile (lower + compile) the bucket's artifact call.  The
         serving loop only calls these executables — an AOT executable
         hard-rejects any other shape/dtype, so 'compiled at warmup' is a
         guarantee, not a hope.  Compiles after warmup are counted:
-        nonzero means the ladder missed a shape and the loop stalled."""
+        nonzero means the ladder missed a shape and the loop stalled.
+
+        Two fast paths skip the compile entirely: a bucket evicted by
+        the HBM budget manager re-opens its (never-deleted) artifact
+        here before re-warming, and a warm AOT cache entry
+        (PADDLE_TPU_AOT_CACHE_DIR) deserializes the stored executable
+        — a cache hit performs ZERO compiles and leaves the compile
+        counters untouched, which is what makes a fresh process's
+        deploy() counter-pinned at 0 on a warm disk cache.  A corrupt
+        entry is counted by the cache and falls through to the normal
+        compile, never a crash."""
         fn = self._compiled.get(bucket)
         if fn is None:
-            srv = self._servers[bucket]
-            zeros = {n: np.zeros((bucket,) + self._example_shapes[n],
-                                 self._dtypes[n])
-                     for n in self._feed_names}
-            with _obs.span('serving.bucket_compile'):
-                fn = srv._call.lower(zeros, srv._key).compile()
+            srv = self._servers.get(bucket)
+            if srv is None:
+                # evicted earlier: the version dir outlives eviction
+                # by contract, so re-open the artifact and re-warm
+                # through the ordinary path below
+                srv = InferenceServer(self._bucket_paths[bucket])
+                self._servers[bucket] = srv
+            if self._aot is not None:
+                fn = self._aot.load_compiled(self._aot_key(bucket))
+            if fn is None:
+                zeros = {n: np.zeros(
+                    (bucket,) + self._example_shapes[n],
+                    self._dtypes[n]) for n in self._feed_names}
+                with _obs.span('serving.bucket_compile'):
+                    fn = srv._call.lower(zeros, srv._key).compile()
+                self._m.compiles.inc()
+                if self._warmup_done:
+                    self._m.compiles_after_warmup.inc()
+                if self._aot is not None:
+                    self._aot.store(
+                        self._aot_key(bucket), fn,
+                        artifact=self._bucket_paths.get(bucket),
+                        bucket=bucket)
             self._compiled[bucket] = fn
-            self._m.compiles.inc()
-            if self._warmup_done:
-                self._m.compiles_after_warmup.inc()
+            self._res_gen[0] += 1
         return fn
+
+    def evict_buckets(self, buckets=None):
+        """The HBM budget manager's eviction unit: drop the compiled
+        executable AND the deserialized artifact for the given buckets
+        (default: the whole ladder).  The version directory is never
+        touched — the next request for an evicted bucket re-opens the
+        artifact and re-compiles through :meth:`_ensure_compiled`
+        (counted as a normal post-warmup compile).  Affects every
+        sibling sharing this servable, by design: the executables are
+        one shared residency.  Returns the modeled bytes freed
+        (resident_bytes delta).  Safe against in-flight batches: a
+        launch holds its own references, so dropping the dict entries
+        frees memory only once the last batch on the executable
+        completes."""
+        before = self.resident_bytes()['total_bytes']
+        targets = (list(self._buckets) if buckets is None
+                   else [int(b) for b in buckets])
+        for b in targets:
+            self._compiled.pop(b, None)
+            self._servers.pop(b, None)
+        self._res_gen[0] += 1
+        return max(0, before - self.resident_bytes()['total_bytes'])
+
+    def bucket_last_used(self):
+        """{bucket: last dispatch stamp (time.monotonic)} across every
+        sibling lane of this servable — buckets never dispatched are
+        absent.  The budget manager's per-bucket LRU signal."""
+        return dict(self._bucket_used)
+
+    @property
+    def residency_generation(self):
+        """Bumped whenever the servable's residency changes (evict or
+        post-warmup (re)compile); the fleet invalidates its cached
+        resident_bytes() snapshots against it."""
+        return self._res_gen[0]
 
     # -- worker threads ------------------------------------------------
     def _pop_batch(self):
@@ -812,7 +915,14 @@ class BatchingInferenceServer(object):
         try:
             bucket, stacked, offsets = self._assemble(reqs)
             fn = self._ensure_compiled(bucket)
-            srv = self._servers[bucket]
+            self._bucket_used[bucket] = time.monotonic()
+            srv = self._servers.get(bucket)
+            if srv is None:
+                # an eviction raced the window since _ensure_compiled:
+                # the executable in hand stays valid, only the _key
+                # holder needs re-opening
+                srv = InferenceServer(self._bucket_paths[bucket])
+                self._servers[bucket] = srv
             if self._stage_to_device:
                 stacked = jax.device_put(stacked)
             outs = list(fn(stacked, srv._key))

@@ -961,7 +961,13 @@ def gc_versions(base_dir, keep=4, protect=()):
     - anything named in ``protect`` (version names like ``'7'`` or
       directory paths — callers pass the fleet's live version dir and
       the ``.prev`` rollback target from its deploy record, so an
-      auto-``rollback()`` always finds its artifacts on disk);
+      auto-``rollback()`` always finds its artifacts on disk; a
+      multi-tenant fleet's ``protected_version_dirs()`` enumerates
+      every tenant's set at once.  Protecting a version dir also keeps
+      its AOT executable-cache entries meaningful —
+      ``inference.aot_cache.AotCache.sweep_orphans`` removes entries
+      whose source artifact this GC deleted, the callers' matching
+      post-GC step);
     - the numerically-highest version, regardless of ``keep`` (a
       concurrent ``deploy(base_dir)`` resolves the highest number
       *before* loading it — ``keep`` is floored at 1 for the same

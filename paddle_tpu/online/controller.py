@@ -352,10 +352,18 @@ class OnlineController(object):
 
     def _protected_dirs(self, extra=()):
         prot = list(extra)
-        for prev in (False, True):
-            rec = self.fleet.deployment(prev=prev)
-            if rec and rec.get('dir'):
-                prot.append(rec['dir'])
+        # a multi-tenant fleet enumerates every tenant's live +
+        # rollback dirs itself (protecting them also keeps the AOT
+        # executable-cache entries keyed off their artifacts useful);
+        # simpler fleet stand-ins fall back to the default-tenant
+        # record walk below
+        if hasattr(self.fleet, 'protected_version_dirs'):
+            prot.extend(self.fleet.protected_version_dirs())
+        else:
+            for prev in (False, True):
+                rec = self.fleet.deployment(prev=prev)
+                if rec and rec.get('dir'):
+                    prot.append(rec['dir'])
         if self.fleet.version is not None:
             prot.append(str(self.fleet.version))
         return prot
@@ -401,6 +409,11 @@ class OnlineController(object):
             self._reset_live_window(version)
             _io.gc_versions(self.export_base, keep=self.keep_versions,
                             protect=self._protected_dirs(extra=[vdir]))
+            # version GC can strand AOT executable-cache entries whose
+            # source artifacts it just removed — give the cache dir
+            # the same orphan sweep (no-op when the cache is disabled)
+            from ..inference.aot_cache import AotCache
+            AotCache().sweep_orphans()
             self._prune_stamps()
         return version
 

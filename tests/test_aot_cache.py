@@ -1,0 +1,168 @@
+"""AotCache: serialized-executable store/load, corruption contract,
+schema-mismatch-as-miss, and the orphan-tombstone sweep.
+
+The serving-level contract (a fresh process's deploy() hitting this
+cache performs zero compiles) is pinned in test_tenancy.py; this file
+covers the cache mechanics in isolation with a plain jitted function.
+"""
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.aot_cache import AotCache, artifact_digest
+
+
+@pytest.fixture
+def cache(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_AOT_CACHE_DIR', str(tmp_path))
+    c = AotCache()
+    if not c.enabled():  # pragma: no cover - container jax has it
+        pytest.skip('jax.experimental.serialize_executable unavailable')
+    return c
+
+
+@pytest.fixture
+def compiled():
+    fn = jax.jit(lambda x: x * 2.0 + 1.0)
+    return fn.lower(np.zeros((4,), np.float32)).compile()
+
+
+def _artifact(tmp_path, name='bucket_4.stablehlo', data=b'module'):
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def test_disabled_without_flag(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_AOT_CACHE_DIR', raising=False)
+    c = AotCache()
+    assert not c.enabled()
+    assert c.load_compiled('0' * 40) is None
+    assert c.store('0' * 40, object()) is False
+    assert c.sweep_orphans() == []
+
+
+def test_key_is_stable_and_sensitive(tmp_path):
+    art = _artifact(tmp_path)
+    d = artifact_digest(art)
+    assert d == artifact_digest(art)  # content-keyed, not path-keyed
+    k = AotCache.key(d, 4)
+    assert k == AotCache.key(d, 4)
+    assert k != AotCache.key(d, 8)                    # bucket
+    assert k != AotCache.key(d, 4, device_kind='tpu')  # hardware
+    d2 = artifact_digest(_artifact(tmp_path, 'other.stablehlo', b'x'))
+    assert k != AotCache.key(d2, 4)                    # model bytes
+
+
+def test_store_load_roundtrip(cache, compiled, tmp_path):
+    art = _artifact(tmp_path)
+    key = AotCache.key(artifact_digest(art), 4)
+    s0 = AotCache.stats()
+    assert cache.load_compiled(key) is None        # cold: miss
+    assert cache.store(key, compiled, artifact=art, bucket=4)
+    fn = cache.load_compiled(key)
+    assert fn is not None
+    out = fn(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(
+        np.asarray(out), np.arange(4, dtype=np.float32) * 2 + 1)
+    s1 = AotCache.stats()
+    assert s1['misses'] == s0['misses'] + 1
+    assert s1['stores'] == s0['stores'] + 1
+    assert s1['hits'] == s0['hits'] + 1
+    assert s1['corrupt'] == s0['corrupt']
+
+
+def test_header_mismatch_is_counted_miss(cache, compiled, tmp_path):
+    """A parseable header for another jax version / device kind is a
+    MISS (the entry is valid, just not for this process) — never
+    corrupt, never a wrong executable."""
+    art = _artifact(tmp_path)
+    key = AotCache.key(artifact_digest(art), 4)
+    assert cache.store(key, compiled, artifact=art, bucket=4)
+    p = cache.path(key)
+    with open(p, 'rb') as f:
+        hdr = json.loads(f.readline().decode())
+        body = f.read()
+    hdr['jax'] = '0.0.0-someday'
+    with open(p, 'wb') as f:
+        f.write(json.dumps(hdr).encode() + b'\n' + body)
+    s0 = AotCache.stats()
+    assert cache.load_compiled(key) is None
+    s1 = AotCache.stats()
+    assert s1['misses'] == s0['misses'] + 1
+    assert s1['corrupt'] == s0['corrupt']
+
+
+def test_corrupt_entry_counts_and_reads_as_miss(cache, compiled,
+                                                tmp_path):
+    art = _artifact(tmp_path)
+    key = AotCache.key(artifact_digest(art), 4)
+    assert cache.store(key, compiled, artifact=art, bucket=4)
+    p = cache.path(key)
+    # poison the pickled body but keep the valid header
+    with open(p, 'rb') as f:
+        hdr_line = f.readline()
+    with open(p, 'wb') as f:
+        f.write(hdr_line + b'\x00garbage-not-a-pickle')
+    s0 = AotCache.stats()
+    assert cache.load_compiled(key) is None
+    assert AotCache.stats()['corrupt'] == s0['corrupt'] + 1
+    # unparseable header too
+    with open(p, 'wb') as f:
+        f.write(b'\xff\xfe not json\n')
+    assert cache.load_compiled(key) is None
+    assert AotCache.stats()['corrupt'] == s0['corrupt'] + 2
+
+
+def test_unpicklable_executable_degrades_quietly(cache, tmp_path):
+    art = _artifact(tmp_path)
+    key = AotCache.key(artifact_digest(art), 4)
+    assert cache.store(key, object(), artifact=art) is False
+    assert not os.path.exists(cache.path(key))
+
+
+def test_sweep_orphans(cache, compiled, tmp_path):
+    live_art = _artifact(tmp_path, 'live.stablehlo', b'live')
+    dead_art = _artifact(tmp_path, 'dead.stablehlo', b'dead')
+    k_live = AotCache.key(artifact_digest(live_art), 1)
+    k_dead = AotCache.key(artifact_digest(dead_art), 2)
+    k_anon = AotCache.key(artifact_digest(live_art), 3)
+    assert cache.store(k_live, compiled, artifact=live_art, bucket=1)
+    assert cache.store(k_dead, compiled, artifact=dead_art, bucket=2)
+    # no provenance recorded: the sweep must keep it (cannot prove
+    # the source is gone)
+    assert cache.store(k_anon, compiled, artifact=None, bucket=3)
+    os.remove(dead_art)  # simulate gc_versions removing the version
+    # a crashed foreign writer's tmp leftover, and our own in-flight
+    foreign_tmp = os.path.join(cache.root,
+                               'aot_dead.bin.tmp.%d' % (os.getpid() + 1))
+    own_tmp = os.path.join(cache.root,
+                           'aot_x.bin.tmp.%d' % os.getpid())
+    open(foreign_tmp, 'wb').close()
+    open(own_tmp, 'wb').close()
+    # a foreign file in the dir: never touched
+    alien = os.path.join(cache.root, 'NOT_OURS.txt')
+    open(alien, 'wb').close()
+    removed = cache.sweep_orphans()
+    assert os.path.basename(cache.path(k_dead)) in removed
+    assert os.path.basename(foreign_tmp) in removed
+    assert os.path.exists(cache.path(k_live))
+    assert os.path.exists(cache.path(k_anon))
+    assert os.path.exists(own_tmp)
+    assert os.path.exists(alien)
+    # the survivor still loads
+    assert cache.load_compiled(k_live) is not None
+
+
+def test_poisoned_header_is_swept(cache, compiled, tmp_path):
+    art = _artifact(tmp_path)
+    key = AotCache.key(artifact_digest(art), 4)
+    assert cache.store(key, compiled, artifact=art, bucket=4)
+    with open(cache.path(key), 'wb') as f:
+        f.write(b'\xff\xfe broken\n')
+    removed = cache.sweep_orphans()
+    assert os.path.basename(cache.path(key)) in removed

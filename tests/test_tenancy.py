@@ -1,0 +1,419 @@
+"""Multi-tenant serving: SLO classes, quotas, HBM admission control,
+and the AOT zero-compile cold-start contract.
+
+Registry-level units run lock-only (no models); the fleet-level tests
+drive real ServingFleets over exported bucketed artifacts, pinning the
+ISSUE-17 acceptance criteria: an over-budget deploy is rejected with a
+typed error BEFORE any build cost, eviction drops compiled buckets but
+never the version dir (re-warm is a counted compile), a fresh process
+over a warm AOT cache reaches serving-ready with compile counters at
+0, and a poisoned AOT entry falls back to compile — counted, never a
+crash.
+"""
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.inference import (AdmissionError, AotCache,
+                                  ServingFleet, export_bucketed)
+from paddle_tpu.inference import tenancy
+
+MAX_BATCH = 4
+
+
+# -- registry / planner units -----------------------------------------
+def test_slo_params_and_unknown_class():
+    w_gold, s_gold = tenancy.slo_params('gold')
+    w_bronze, s_bronze = tenancy.slo_params('bronze')
+    assert w_gold > w_bronze and s_gold < 1.0 < s_bronze
+    assert tenancy.slo_params('silver')[1] == 1.0  # the fixed point
+    with pytest.raises(ValueError, match='unknown SLO class'):
+        tenancy.slo_params('platinum')
+
+
+def test_effective_quota(monkeypatch):
+    assert tenancy.effective_quota(7, 'bronze') == 7  # explicit wins
+    assert tenancy.effective_quota(None, 'gold') == 0  # flag off
+    monkeypatch.setenv('PADDLE_TPU_FLEET_TENANT_QUOTA', '16')
+    assert tenancy.effective_quota(None, 'gold') == 16
+    assert tenancy.effective_quota(None, 'silver') == 8
+    assert tenancy.effective_quota(None, 'bronze') == 2
+    monkeypatch.setenv('PADDLE_TPU_FLEET_TENANT_QUOTA', '1')
+    assert tenancy.effective_quota(None, 'bronze') == 1  # floored
+
+
+def test_plan_eviction_orders_coldest_first():
+    cands = [
+        {'tenant': 'hot', 'tenant_last_used': 100.0, 'bucket': 1,
+         'bucket_last_used': 99.0, 'bytes': 50},
+        {'tenant': 'cold', 'tenant_last_used': 10.0, 'bucket': 4,
+         'bucket_last_used': 9.0, 'bytes': 40},
+        {'tenant': 'cold', 'tenant_last_used': 10.0, 'bucket': 2,
+         'bucket_last_used': 5.0, 'bytes': 30},
+    ]
+    plan, freed = tenancy.plan_eviction(cands, 60)
+    # coldest tenant first, coldest bucket within it; shortest prefix
+    assert [(c['tenant'], c['bucket']) for c in plan] == \
+        [('cold', 2), ('cold', 4)]
+    assert freed == 70
+    assert tenancy.plan_eviction(cands, 0) == ([], 0)
+    # ties on staleness: larger bucket first, so the plan stays short
+    tied = [dict(c, tenant_last_used=1.0, bucket_last_used=1.0)
+            for c in cands]
+    plan, _ = tenancy.plan_eviction(tied, 10)
+    assert plan[0]['bytes'] == 50
+
+
+def test_admission_error_payload():
+    e = AdmissionError('t', 'v7', budget_bytes=100, live_bytes=80,
+                       incoming_bytes=60, freed_bytes=20)
+    assert e.projected_bytes == 140
+    assert 'rejected' in str(e) and 'v7' in str(e)
+    assert isinstance(e, RuntimeError)
+
+
+def test_registry_quota_park_and_release():
+    reg = tenancy.TenantRegistry()
+    reg.ensure('a', slo_class='silver', quota=2)
+    assert reg.admit('a', 'r1') and reg.admit('a', 'r2')
+    assert not reg.admit('a', 'r3')  # at quota: parked, not dropped
+    assert reg.pending_total() == 1
+    assert reg.info('a')['deferred'] == 1
+    assert reg.take_deferred() == []  # still at quota
+    reg.release_one('a')
+    assert reg.take_deferred() == [('a', 'r3')]
+    assert reg.pending_total() == 0
+    # quota 0 = unlimited
+    reg.ensure('free', quota=0)
+    assert all(reg.admit('free', i) for i in range(100))
+
+
+def test_registry_wrr_drain_is_weighted_not_starving():
+    """Under contention gold drains ~8 items for bronze's 1 — and
+    bronze is never starved out of a full rotation."""
+    reg = tenancy.TenantRegistry()
+    reg.ensure('g', slo_class='gold', quota=9)
+    reg.ensure('b', slo_class='bronze', quota=9)
+    for name in ('g', 'b'):
+        for i in range(9):
+            assert reg.admit(name, i)       # fill the quota
+        for i in range(9):
+            assert not reg.admit(name, i)   # park 9 more
+        for _ in range(9):
+            reg.release_one(name)           # free every slot
+    got = reg.take_deferred(max_items=9)
+    names = [n for n, _ in got]
+    assert names.count('g') == 8 and names.count('b') == 1
+
+
+def test_registry_drain_all_ignores_quota():
+    reg = tenancy.TenantRegistry()
+    reg.ensure('a', quota=1)
+    reg.admit('a', 'live')
+    for i in range(3):
+        reg.admit('a', i)
+    assert len(reg.drain_all()) == 3
+    assert reg.pending_total() == 0
+
+
+def test_registry_regrade_rederives_flag_quota(monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_FLEET_TENANT_QUOTA', '16')
+    reg = tenancy.TenantRegistry()
+    assert reg.ensure('t', slo_class='bronze')[3] == 2
+    # re-deploy with a better class: flag-derived quota follows
+    assert reg.ensure('t', slo_class='gold')[3] == 16
+    # explicit quota survives a class change
+    assert reg.ensure('t', slo_class='bronze', quota=5)[3] == 5
+
+
+# -- fleet integration ------------------------------------------------
+def _build_mlp(seed):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        pred = fluid.layers.fc(input=h, size=4)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    return main, scope, exe, pred
+
+
+@pytest.fixture(scope='module')
+def models(tmp_path_factory):
+    """Three exported models (different seeds), one dir each."""
+    base = tmp_path_factory.mktemp('tenant_models')
+    out = {}
+    for name, seed in (('a', 11), ('b', 42), ('c', 77)):
+        main, scope, exe, pred = _build_mlp(seed)
+        d = str(base / name)
+        export_bucketed(d, {'x': (6,)}, [pred], executor=exe,
+                        main_program=main, scope=scope,
+                        max_batch=MAX_BATCH)
+        out[name] = d
+    return out
+
+
+def _feed(rows=2):
+    rng = np.random.RandomState(0)
+    return {'x': rng.randn(rows, 6).astype('float32')}
+
+
+def _mk_fleet(vdir, **kw):
+    kw.setdefault('replicas', 1)
+    kw.setdefault('max_wait_ms', 20.0)
+    kw.setdefault('linger_ms', 0.5)
+    kw.setdefault('health_interval_ms', 0)
+    return ServingFleet(vdir, **kw)
+
+
+def test_multi_tenant_deploy_route_and_records(models, tmp_path):
+    state = str(tmp_path / 'state')
+    fleet = _mk_fleet(models['a'], state_dir=state, tenant='alpha',
+                      slo_class='gold')
+    try:
+        fleet.deploy(models['b'], replicas=1, tenant='beta',
+                     slo_class='bronze')
+        ra = fleet.predict(_feed(), tenant='alpha')
+        rb = fleet.predict(_feed(), tenant='beta')
+        # distinct servables: different seeds, different outputs
+        assert not np.allclose(ra[0], rb[0])
+        st = fleet.stats()
+        assert sorted(st['tenants']) == ['alpha', 'beta']
+        assert st['tenants']['alpha']['slo_class'] == 'gold'
+        assert st['tenants']['beta']['slo_class'] == 'bronze'
+        assert {p['tenant'] for p in st['replicas']} \
+            == {'alpha', 'beta'}
+        assert sorted(fleet.tenants()) == ['alpha', 'beta']
+        # each tenant keeps its own deploy record + rollback chain
+        assert fleet.deployment(tenant='alpha')['tenant'] == 'alpha'
+        assert fleet.deployment(tenant='beta')['slo_class'] == 'bronze'
+        assert os.path.exists(
+            os.path.join(state, 'DEPLOY_beta.json'))
+        # ambiguous tenant= is loud, not guessed
+        with pytest.raises(ValueError, match='pass tenant='):
+            fleet.submit(_feed())
+        with pytest.raises(ValueError, match='no tenant'):
+            fleet.submit(_feed(), tenant='nobody')
+        # the protect set spans every tenant's live dir
+        prot = [os.path.abspath(p)
+                for p in fleet.protected_version_dirs()]
+        assert os.path.abspath(models['a']) in prot
+        assert os.path.abspath(models['b']) in prot
+    finally:
+        fleet.close()
+
+
+def test_single_tenant_defaults_are_implicit(models):
+    """Opt-in contract: no tenant= anywhere means one 'default'
+    tenant, silver class (the 1.0 fixed point), warn admission — the
+    pre-tenancy surface exactly."""
+    fleet = _mk_fleet(models['a'])
+    try:
+        fleet.predict(_feed())   # no tenant= needed
+        st = fleet.stats()
+        assert list(st['tenants']) == [tenancy.DEFAULT_TENANT]
+        t = st['tenants'][tenancy.DEFAULT_TENANT]
+        assert t['slo_class'] == 'silver'
+        assert t['wait_scale'] == 1.0 and t['quota'] == 0
+        assert st['admission_mode'] == 'warn'
+        assert st['quota_deferred'] == 0
+    finally:
+        fleet.close()
+
+
+def test_enforce_rejects_over_budget_before_build(models):
+    fleet = _mk_fleet(models['a'], hbm_admission='enforce')
+    try:
+        n_before = len(fleet._replicas)
+        with pytest.raises(AdmissionError) as ei:
+            fleet.deploy(models['b'], replicas=1, tenant='beta',
+                         hbm_budget_bytes=1)
+        assert ei.value.tenant == 'beta'
+        assert ei.value.budget_bytes == 1
+        st = fleet.stats()
+        assert st['admission_rejections'] == 1
+        assert st['hbm_budget_precheck_failures'] == 1
+        # rejected BEFORE any build cost: no replica was created for
+        # the tenant, the live set is untouched, and no record exists
+        assert len(fleet._replicas) == n_before
+        assert 'beta' not in fleet.tenants()
+        assert fleet.deployment(tenant='beta') is None
+        fleet.predict(_feed())  # the resident tenant still serves
+    finally:
+        fleet.close()
+
+
+def test_enforce_evicts_cold_tenant_then_rewarns_counted(models):
+    """An over-budget deploy LRU-evicts the coldest tenant's compiled
+    buckets (never its version dir); that tenant's next request
+    re-warms through the normal counted compile path."""
+    fleet = _mk_fleet(models['a'], tenant='cold',
+                      hbm_admission='enforce')
+    try:
+        fleet.deploy(models['b'], replicas=1, tenant='hot')
+        fleet.predict(_feed(), tenant='cold')
+        fleet.predict(_feed(), tenant='hot')  # 'hot' touched last
+        st = fleet.stats()
+        resident = st['resident_bytes']
+        cold_rep, = [r for r in fleet._replicas
+                     if r.tenant == 'cold']
+        cold_bytes = cold_rep.server.resident_bytes()['total_bytes']
+        incoming = sum(
+            os.path.getsize(p) for p in
+            io.bucket_artifacts(models['c']).values())
+        # a budget that fits ONLY after evicting roughly the cold
+        # tenant's residency (and nothing forces touching 'hot')
+        budget = resident + incoming - cold_bytes + 16
+        fleet.deploy(models['c'], replicas=1, tenant='third',
+                     hbm_budget_bytes=budget)
+        st = fleet.stats()
+        assert st['evictions'] >= 1
+        assert st['tenants']['cold']['evicted_buckets'] >= 1
+        assert st['tenants']['hot']['evicted_buckets'] == 0
+        # the version dir survived eviction — the cold tenant still
+        # serves, paying a counted post-warmup recompile
+        before = cold_rep.server.stats()['compiles_after_warmup']
+        out = fleet.predict(_feed(), tenant='cold')
+        assert out[0].shape == (2, 4)
+        assert cold_rep.server.stats()['compiles_after_warmup'] \
+            > before
+    finally:
+        fleet.close()
+
+
+def test_quota_defers_never_drops(models):
+    """A tenant past its quota gets submits parked and drained as
+    completions free slots: every request completes, the deferral is
+    counted, and nothing is dropped."""
+    fleet = _mk_fleet(models['a'], tenant='q', quota=1,
+                      max_wait_ms=1.0)
+    try:
+        futs = [fleet.submit(_feed(1), tenant='q') for _ in range(16)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert len(outs) == 16
+        assert all(o[0].shape == (1, 4) for o in outs)
+        st = fleet.stats()
+        assert st['tenants']['q']['quota'] == 1
+        assert st['quota_deferred'] >= 1   # at least one was parked
+        assert st['quota_pending'] == 0    # and all drained
+        assert st['completed'] == 16 and st['failed'] == 0
+    finally:
+        fleet.close()
+
+
+def test_close_fails_parked_requests_instead_of_hanging(models):
+    fleet = _mk_fleet(models['a'], tenant='q', quota=1,
+                      max_wait_ms=1.0)
+    # park requests by filling the quota with a request that will
+    # complete during close()'s drain
+    futs = [fleet.submit(_feed(1), tenant='q') for _ in range(8)]
+    fleet.close()
+    for f in futs:
+        assert f.done()  # resolved either way — never hung
+        if f.exception() is not None:
+            # a park drained mid-close dispatches into the retired
+            # set ('no routable replica'); one still parked at the
+            # end is failed by close itself ('quota queue')
+            assert ('quota queue' in str(f.exception())
+                    or 'no routable replica' in str(f.exception()))
+
+
+def test_cold_start_zero_compiles_from_warm_aot_cache(
+        models, tmp_path, monkeypatch):
+    """The tentpole contract: a simulated fresh process (cleared
+    in-process jax caches, warm disk cache) reaches serving-ready
+    with compile counters pinned at 0 — warmup AND post-warmup."""
+    monkeypatch.setenv('PADDLE_TPU_AOT_CACHE_DIR',
+                       str(tmp_path / 'aot'))
+    n_buckets = len(io.bucket_artifacts(models['a']))
+    fleet = _mk_fleet(models['a'], replicas=2)
+    try:
+        s0 = AotCache.stats()
+        st = fleet.stats()
+        # first process compiled once per bucket and serialized each
+        assert st['replicas'][0]['compiles'] == n_buckets
+        fleet.predict(_feed())
+    finally:
+        fleet.close()
+    assert s0['stores'] >= n_buckets
+
+    jax.clear_caches()  # the in-process caches of a 'fresh process'
+    s1 = AotCache.stats()
+    fleet2 = _mk_fleet(models['a'], replicas=2)
+    try:
+        st = fleet2.stats()
+        for p in st['replicas']:
+            assert p['compiles'] == 0, \
+                'warm AOT cache must make warmup compile-free'
+            assert p['compiles_after_warmup'] == 0
+        # deserialized, not recompiled: one hit per bucket
+        assert AotCache.stats()['hits'] >= s1['hits'] + n_buckets
+        # and serving real traffic keeps the counters at 0
+        out = fleet2.predict(_feed())
+        assert out[0].shape == (2, 4)
+        st = fleet2.stats()
+        assert all(p['compiles'] == 0
+                   and p['compiles_after_warmup'] == 0
+                   for p in st['replicas'])
+    finally:
+        fleet2.close()
+
+
+def test_poisoned_aot_entry_falls_back_to_compile(models, tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_AOT_CACHE_DIR',
+                       str(tmp_path / 'aot'))
+    n_buckets = len(io.bucket_artifacts(models['b']))
+    fleet = _mk_fleet(models['b'])
+    fleet.close()
+    cache = AotCache()
+    entries = [e for e in os.listdir(cache.root)
+               if e.startswith('aot_') and e.endswith('.bin')]
+    assert len(entries) >= n_buckets
+    for e in entries:  # poison every body, keep the headers
+        p = os.path.join(cache.root, e)
+        with open(p, 'rb') as f:
+            hdr = f.readline()
+        with open(p, 'wb') as f:
+            f.write(hdr + b'\x00not-a-pickle')
+    jax.clear_caches()
+    s0 = AotCache.stats()
+    fleet2 = _mk_fleet(models['b'])
+    try:
+        st = fleet2.stats()
+        # fell back to the normal counted compile path — no crash
+        assert st['replicas'][0]['compiles'] == n_buckets
+        assert AotCache.stats()['corrupt'] >= s0['corrupt'] + n_buckets
+        fleet2.predict(_feed())
+    finally:
+        fleet2.close()
+
+
+def test_redeploy_resident_version_reuses_servable(models):
+    """Satellite: redeploying the version a tenant already serves
+    brings ZERO incoming bytes (shared-servable dedupe) and reuses
+    the compiled servable — no budget trip, no recompile."""
+    fleet = _mk_fleet(models['a'], replicas=2)
+    try:
+        st = fleet.stats()
+        resident = st['resident_bytes']
+        assert st['hbm_budget_precheck_failures'] == 0
+        # budget == exactly the current residency: any nonzero
+        # incoming projection would trip it
+        fleet.deploy(models['a'], replicas=2,
+                     hbm_budget_bytes=resident)
+        st = fleet.stats()
+        assert st['hbm_budget_precheck_failures'] == 0
+        assert st['admission_rejections'] == 0
+        # the new lanes shared the resident servable: zero compiles
+        assert all(p['compiles'] == 0 for p in st['replicas'])
+        fleet.predict(_feed())
+    finally:
+        fleet.close()
