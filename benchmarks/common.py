@@ -163,12 +163,18 @@ def ensure_mesh_devices(mesh_specs):
             piece = piece.strip()
             if not piece or piece in ('off', '1'):
                 continue
+            if '=' in piece:
+                size = piece.split('=', 1)[1]
+            else:
+                # compact axisN form ('pp2', 'dp4'): trailing digits
+                size = piece.rstrip('0123456789')
+                size = piece[len(size):]
             try:
-                n *= max(int(piece.split('=', 1)[1]), 1)
-            except (IndexError, ValueError):
+                n *= max(int(size), 1)
+            except (TypeError, ValueError):
                 raise SystemExit(
-                    "--mesh %r: piece %r is not axis=size" % (spec,
-                                                              piece))
+                    "--mesh %r: piece %r is not axis=size (or compact "
+                    "axisN, e.g. pp2)" % (spec, piece))
         need = max(need, n)
     flags = os.environ.get('XLA_FLAGS', '')
     if need > 1 and '--xla_force_host_platform_device_count' not in flags:
@@ -262,6 +268,48 @@ def mesh_bench(metric, unit_count, build, feed_fn, mesh_specs,
                         row['est_collective_s_per_step'] = round(
                             coll['est_wall_s'] / max(rep.get('k', 1),
                                                      1), 6)
+                    # collective-overlap verdict (transpiler/overlap.py
+                    # schedule): what fraction of the comm hid behind
+                    # compute, and the exposed remainder in modeled
+                    # ms/step.  The executor's number is the static
+                    # roofline-priced schedule (the bench's async
+                    # run_steps never syncs inside the executor); like
+                    # the MFU convention above, re-price it here at the
+                    # bench's own synced step wall — same buckets, same
+                    # serial-channel arithmetic, real time base
+                    if coll.get('overlap_fraction') is not None:
+                        row['overlap_fraction'] = round(
+                            coll['overlap_fraction'], 4)
+                        row['overlap_basis'] = coll.get('overlap_basis')
+                        row['exposed_ici_bytes_per_step'] = coll.get(
+                            'exposed_bytes_per_step', 0)
+                        if coll.get('exposed_est_wall_s') is not None:
+                            row['exposed_comm_ms_per_step'] = round(
+                                coll['exposed_est_wall_s'] * 1e3, 4)
+                    cost = rep.get('cost') or {}
+                    ccost = cost.get('collectives') or {}
+                    sched = ccost.get('overlap')
+                    if sched and sched.get('buckets') and \
+                            ccost.get('modeled_compute_s'):
+                        from paddle_tpu.transpiler.cost_model import \
+                            overlap_schedule
+                        scale = step_s / ccost['modeled_compute_s']
+                        meas = overlap_schedule(
+                            sched['buckets'],
+                            sched['backward_s'] * scale,
+                            sched['window_s'] * scale,
+                            sched['ici_gbps'] * 1e9)
+                        row['overlap_fraction'] = round(
+                            meas['overlap_fraction'], 4)
+                        row['overlap_basis'] = 'measured-step'
+                        row['exposed_ici_bytes_per_step'] = \
+                            meas['exposed_bytes']
+                        row['exposed_comm_ms_per_step'] = round(
+                            meas['exposed_bytes'] /
+                            (sched['ici_gbps'] * 1e9) * 1e3, 4)
+                    if coll.get('pp'):
+                        row['pp_bubble_fraction'] = coll['pp'].get(
+                            'bubble_fraction')
                 comp = phases.get('compute') or {}
                 peak = os.environ.get('PADDLE_TPU_PEAK_TFLOPS')
                 if peak and comp.get('flops_per_step'):

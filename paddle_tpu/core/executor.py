@@ -198,6 +198,20 @@ class _ExecutorMetrics(object):
             'modeled collective operations (gradient allreduce, fsdp '
             'reduce-scatter/all-gather) executed inside SPMD steps, '
             'summed over steps').child()
+        self.collective_exposed_bytes = r.counter(
+            'paddle_tpu_executor_collective_exposed_bytes_total',
+            'modeled ICI bytes NOT hidden behind compute: the exposed '
+            'remainder of the overlap schedule (gradient-bucket '
+            'allreduces past the backward+update window, pipeline '
+            'ppermute sends past their stage tick), summed over steps '
+            '— the serial communication tax the overlap pass could '
+            'not remove').child()
+        self.collective_overlapped_bytes = r.counter(
+            'paddle_tpu_executor_collective_overlapped_bytes_total',
+            'modeled ICI bytes hidden behind concurrent compute by '
+            'the collective-overlap schedule '
+            '(PADDLE_TPU_OVERLAP / transpiler/overlap.py), summed '
+            'over steps').child()
 
 
 _exec_metrics = None
@@ -580,6 +594,24 @@ def _run_autodiff(ad_op, fwd_ops, env, ctx, pre_update_vals, publish):
                 env[n] = env_fwd[n]
         if loss_name not in written and loss_name in env_fwd:
             env[loss_name] = env_fwd[loss_name]
+    # overlap_collectives lowering: tie each bucket's gradients together
+    # with one optimization_barrier — an identity (bitwise-same values,
+    # donation-safe) that hands XLA's latency-hiding scheduler a
+    # per-bucket dependency cut, so the bucket's allreduce/
+    # reduce-scatter issues when ITS grads retire instead of after the
+    # whole backward.  No attr (pass off / no mesh) -> path untouched.
+    buckets = ad_op.attrs.get('overlap_buckets')
+    if buckets:
+        grad_to_param = dict(zip(grad_names, param_names))
+        for bucket in buckets:
+            pns = [grad_to_param[gn] for gn in bucket
+                   if grad_to_param.get(gn) in grads]
+            if not pns:
+                continue
+            vals = jax.lax.optimization_barrier(
+                tuple(grads[pn] for pn in pns))
+            for pn, v in zip(pns, vals):
+                grads[pn] = v
     for pn, gn in zip(param_names, grad_names):
         g = grads[pn]
         env[gn] = g.astype(params[pn].dtype) if hasattr(g, 'astype') else g
@@ -995,6 +1027,29 @@ class Executor(object):
         axes = _compat.mesh_axes_from_flag()
         if axes is None:
             return None
+        pp_size = int(dict(axes).get('pp', 1))
+        if pp_size > 1:
+            # pp shards TIME, not tensors: a pipeline axis cannot be
+            # lowered as one pjit program — it needs the 1F1B
+            # schedule's per-stage branches and ppermute transfers.
+            # Only TRAIN steps (programs carrying an autodiff op) are
+            # refused; startup init and plain forwards run replicated
+            # over the pipeline, i.e. with the time axis dropped
+            if any(op.type == 'autodiff'
+                   for b in program.blocks for op in b.ops):
+                raise RuntimeError(
+                    'PADDLE_TPU_MESH declares a pipeline axis '
+                    '(pp=%d), which the single-program SPMD executor '
+                    'cannot lower for a train step.  Route the '
+                    'program through the 1F1B engine instead: '
+                    'paddle_tpu.distributed.pipeline.from_mesh('
+                    'program, ...) cuts stages at annotate_pp_cut() '
+                    'boundaries and schedules microbatches — or drop '
+                    'the pp axis (e.g. PADDLE_TPU_MESH=dp%d) to stay '
+                    'on the plain SPMD path.' % (pp_size, pp_size))
+            axes = tuple((n, s) for n, s in axes if n != 'pp')
+            if not any(int(s) > 1 for _, s in axes):
+                return None
         key = (program._uid, program.version)
         has_pdo = self._mesh_op_cache.get(key)
         if has_pdo is None:
@@ -1058,37 +1113,109 @@ class Executor(object):
                     smeta['mesh'], (None,) + tuple(feeds.get(n) or ()))
                 for n in names}
 
-    def _note_collectives(self, tl, steps):
+    def _note_collectives(self, tl, steps, compute_s=None):
         """Attribute the modeled ICI collectives of ``steps`` executed
         SPMD steps: counters (modeled bytes + collective ops) and one
         ``collective``-category timeline event, with an estimated wall
         when PADDLE_TPU_ICI_GBPS names a link bandwidth.  The numbers
         come from the cost model's pricing of the sharding pass's
-        collective table, cached per plan in last_graph_opt_report."""
+        collective table, cached per plan in last_graph_opt_report.
+
+        ``compute_s`` is the MEASURED compute wall for the ``steps``
+        steps, when the caller has a synced one (run_steps does; the
+        async single-step dispatch does not).  The overlap schedule the
+        cost model priced at roofline-floor walls is pure arithmetic
+        over the stamped bucket descriptors, so it is re-run here with
+        every wall scaled by measured/modeled compute — same buckets,
+        same serial-channel model, real time base — and the reported
+        overlap fraction then describes the step that actually ran
+        instead of the optimistic floor.  The fraction lands as a
+        Chrome-trace counter series
+        (``paddle_tpu.collective_overlap_pct``, 0-100) next to the
+        collective event."""
         cost = (self.last_graph_opt_report or {}).get('cost') or {}
         coll = cost.get('collectives')
         if not coll or not coll.get('ici_bytes'):
             return None
         nbytes = int(coll['ici_bytes']) * int(steps)
         nops = len(coll.get('items') or ()) * int(steps)
+        sched = coll.get('overlap')
+        split = dict(coll.get('bytes') or {})
+        frac = sched.get('overlap_fraction') if sched else None
+        basis = 'modeled-roofline'
+        if sched and sched.get('buckets') and compute_s \
+                and compute_s > 0.0:
+            modeled = float(coll.get('modeled_compute_s') or 0.0)
+            if modeled > 0.0:
+                from ..transpiler import cost_model as _cmod
+                scale = (float(compute_s) / int(steps)) / modeled
+                rerun = _cmod.overlap_schedule(
+                    sched['buckets'],
+                    float(sched['backward_s']) * scale,
+                    float(sched['window_s']) * scale,
+                    float(sched['ici_gbps']) * 1e9)
+                frac = rerun['overlap_fraction']
+                # only the gradient-bucket term is re-priced; every
+                # other exposed byte (pp sends, unbucketed items)
+                # keeps its static verdict
+                exposed = max(0, int(split.get('exposed') or 0)
+                              - int(sched.get('exposed_bytes') or 0)
+                              + int(rerun['exposed_bytes']))
+                split['exposed'] = min(exposed,
+                                       int(split.get('total') or 0))
+                split['overlapped'] = (int(split.get('total') or 0)
+                                       - split['exposed'])
+                basis = 'measured-compute'
         if _obs.enabled():
             em = _em()
             em.collective_modeled_bytes.inc(nbytes)
             em.collectives_modeled.inc(nops)
+            if split:
+                em.collective_exposed_bytes.inc(
+                    int(split.get('exposed') or 0) * int(steps))
+                em.collective_overlapped_bytes.inc(
+                    int(split.get('overlapped') or 0) * int(steps))
         est = None
         from ..flags import FLAGS
         gbps = float(FLAGS.ici_gbps or 0.0)
         if gbps > 0:
             est = nbytes / (gbps * 1e9)
+        out = {'ici_bytes': nbytes, 'collectives': nops,
+               'est_wall_s': est, 'by_kind': coll.get('by_kind')}
+        if frac is not None:
+            mgbps = float(sched.get('ici_gbps') or 0.0)
+            out['overlap_fraction'] = frac
+            out['overlap_basis'] = basis
+            out['exposed_bytes_per_step'] = int(split.get('exposed')
+                                                or 0)
+            out['overlapped_bytes_per_step'] = \
+                int(split.get('overlapped') or 0)
+            if mgbps > 0:
+                out['exposed_est_wall_s'] = \
+                    out['exposed_bytes_per_step'] / (mgbps * 1e9)
+        if coll.get('pp'):
+            out['pp'] = dict(coll['pp'])
         if tl is not None:
+            args = {'modeled_ici_bytes': nbytes,
+                    'collectives': nops,
+                    'by_kind': dict(coll.get('by_kind') or {}),
+                    'est_wall_s': est}
+            if frac is not None:
+                args['overlap_fraction'] = frac
+                args['overlap_basis'] = basis
+                args['exposed_bytes_per_step'] = \
+                    out['exposed_bytes_per_step']
+            if frac is not None:
+                # counter samples are integer-valued (args['bytes']):
+                # the fraction rides as a 0-100 percent series.
+                # Sampled BEFORE the record event so the category's
+                # latest event stays the attribution record
+                tl.counter_sample(
+                    'paddle_tpu.collective_overlap_pct',
+                    round(frac * 100.0), cat='collective')
             tl.record('executor.collective', 'collective',
-                      dur=est or 0.0,
-                      args={'modeled_ici_bytes': nbytes,
-                            'collectives': nops,
-                            'by_kind': dict(coll.get('by_kind') or {}),
-                            'est_wall_s': est})
-        return {'ici_bytes': nbytes, 'collectives': nops,
-                'est_wall_s': est, 'by_kind': coll.get('by_kind')}
+                      dur=est or 0.0, args=args)
+        return out
 
     def _active_mesh(self, program):
         """The current mesh_guard mesh, when `program` contains an op
@@ -1886,7 +2013,9 @@ class Executor(object):
         # k steps' collectives moved, priced by the cost model from
         # the sharding pass's table — attributed like feed/compute/
         # update, with a wall estimate when PADDLE_TPU_ICI_GBPS is set
-        noted = self._note_collectives(_tlm.ring_if_armed(), k)
+        noted = self._note_collectives(
+            _tlm.ring_if_armed(), k,
+            compute_s=compute if (synced and compute > 0.0) else None)
         if noted is not None:
             report['phases']['collective'] = {
                 'modeled_ici_bytes': noted['ici_bytes'],
@@ -1895,6 +2024,12 @@ class Executor(object):
                 'by_kind': dict(noted.get('by_kind') or {}),
                 'est_wall_s': noted['est_wall_s'],
             }
+            for fld in ('overlap_fraction', 'overlap_basis',
+                        'exposed_bytes_per_step',
+                        'overlapped_bytes_per_step',
+                        'exposed_est_wall_s', 'pp'):
+                if fld in noted:
+                    report['phases']['collective'][fld] = noted[fld]
         report['cost'] = cost
         measured = _tlm.device_memory_stats(self._memory_device())
         report['memory'] = self._memory_report(cost, measured)

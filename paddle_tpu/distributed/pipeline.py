@@ -46,7 +46,76 @@ from ..core.scope import global_scope
 from ..parallel import collective
 from ..parallel.pipeline import pipeline_train_1f1b
 
-__all__ = ['PipelineTranspiler']
+__all__ = ['PipelineTranspiler', 'annotate_pp_cut', 'from_mesh']
+
+
+def annotate_pp_cut(var, program=None):
+    """Mark ``var`` as a pipeline-stage boundary candidate.
+
+    The name lands on ``program._pp_cut_names`` where BOTH consumers
+    read it: the sharding pass's pp planner (bubble/ppermute terms in
+    the cost report when PADDLE_TPU_MESH carries a pp axis) and
+    :func:`from_mesh` (actual stage cutting).  Annotating more
+    boundaries than stages is encouraged — the planner picks the
+    compute-balanced subset (``transpiler.sharding.select_pp_cuts``).
+    Returns ``var`` so the call nests inside layer expressions.
+    """
+    program = program or default_main_program()
+    name = var.name if isinstance(var, Variable) else str(var)
+    cuts = getattr(program, '_pp_cut_names', None)
+    if cuts is None:
+        cuts = []
+        program._pp_cut_names = cuts
+    if name not in cuts:
+        cuts.append(name)
+    return var
+
+
+def from_mesh(program=None, pp_axis='pp', cut_vars=None,
+              num_microbatches=None):
+    """Mesh-driven pipeline entry: the PADDLE_TPU_MESH counterpart of
+    hand-constructing a :class:`PipelineTranspiler`.
+
+    Reads the pipeline depth from the mesh flag's ``pp`` axis (e.g.
+    ``PADDLE_TPU_MESH=pp2,dp=2`` — compact and ``axis=size`` forms both
+    parse), cuts the program at its :func:`annotate_pp_cut` boundaries
+    (auto-balancing when more were annotated than needed), builds the
+    mesh, and returns the transpiled instance with ``mesh`` and
+    ``num_microbatches`` (PADDLE_TPU_PP_MICROBATCHES unless overridden)
+    attached — drive steps with :meth:`PipelineTranspiler.run_mesh_step`.
+
+    This is the path the SPMD executor's pp refusal points at: a pp
+    axis shards TIME, so it cannot lower as one pjit program — it needs
+    the 1F1B engine's per-stage branches and ppermute transfers.
+    """
+    from ..flags import FLAGS
+    from . import _compat
+    program = program or default_main_program()
+    axes = _compat.mesh_axes_from_flag()
+    sizes = dict(axes or ())
+    stages = int(sizes.get(pp_axis, 0))
+    if stages < 2:
+        raise ValueError(
+            "from_mesh needs a %r axis of size >= 2 in PADDLE_TPU_MESH "
+            "(e.g. PADDLE_TPU_MESH=%s2,dp=2); got %r"
+            % (pp_axis, pp_axis, dict(sizes)))
+    if cut_vars is None:
+        from ..transpiler.sharding import select_pp_cuts
+        names = list(getattr(program, '_pp_cut_names', ()) or ())
+        cuts = select_pp_cuts(program, names, stages)
+        if cuts is None:
+            raise ValueError(
+                "a %d-stage pipeline needs at least %d annotated "
+                "boundaries; annotate forward activations with "
+                "distributed.pipeline.annotate_pp_cut(var) (got %d "
+                "usable: %s)" % (stages, stages - 1, len(names), names))
+        cut_vars = list(cuts)
+    t = PipelineTranspiler()
+    t.transpile(program, cut_vars=cut_vars, pp_axis=pp_axis)
+    t.mesh = _compat.mesh_for(axes)
+    t.num_microbatches = max(
+        int(num_microbatches or FLAGS.pp_microbatches or 1), 1)
+    return t
 
 
 class PipelineTranspiler(object):
@@ -218,6 +287,18 @@ class PipelineTranspiler(object):
         return stage
 
     # ------------------------------------------------------------------
+    def run_mesh_step(self, exe, feed, scope=None):
+        """One pipelined step under the :func:`from_mesh` configuration
+        (the flag-derived mesh and microbatch count attached there)."""
+        mesh = getattr(self, 'mesh', None)
+        if mesh is None:
+            raise RuntimeError(
+                "run_mesh_step needs a from_mesh()-built transpiler "
+                "(no mesh attached); use run_step(exe, feed, M, "
+                "mesh=...) directly")
+        return self.run_step(exe, feed, self.num_microbatches,
+                             scope=scope, mesh=mesh)
+
     def run_step(self, exe, feed, num_microbatches, scope=None,
                  mesh=None):
         """One pipelined train step: split `feed` into M microbatches,

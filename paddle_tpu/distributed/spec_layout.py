@@ -44,7 +44,12 @@ __all__ = ['parse_mesh_spec', 'SpecLayout', 'build_param_specs',
 # reaches every consumer (plan keys compare strings)
 AXIS_ALIASES = {'dp': 'dp', 'data': 'dp',
                 'fsdp': 'fsdp', 'zero': 'fsdp',
-                'tp': 'tp', 'mp': 'tp', 'model': 'tp'}
+                'tp': 'tp', 'mp': 'tp', 'model': 'tp',
+                'pp': 'pp', 'pipe': 'pp'}
+
+# compact mesh piece: axis name immediately followed by its size
+# ('pp2', 'fsdp4') — sugar for the canonical 'axis=size' form
+_COMPACT_PIECE = re.compile(r'^([a-z]+?)(\d+)$')
 
 # optimizer accumulator naming: _add_accumulator creates
 # unique_name('<param>_<stem>') = '<param>_<stem>_<n>' with the PARAM's
@@ -67,8 +72,12 @@ def parse_mesh_spec(s):
         if not piece:
             continue
         if '=' not in piece:
-            raise ValueError(
-                "PADDLE_TPU_MESH piece %r is not axis=size" % piece)
+            m = _COMPACT_PIECE.match(piece.strip().lower())
+            if m is None:
+                raise ValueError(
+                    "PADDLE_TPU_MESH piece %r is not axis=size "
+                    "(or compact axisN, e.g. pp2)" % piece)
+            piece = '%s=%s' % (m.group(1), m.group(2))
         name, _, size = piece.partition('=')
         name = AXIS_ALIASES.get(name.strip().lower())
         if name is None:
@@ -139,11 +148,15 @@ class SpecLayout(object):
     """
 
     def __init__(self, axes, data_axis='dp', fsdp_axis='fsdp',
-                 tp_axis='tp', embed_pad=True):
+                 tp_axis='tp', embed_pad=True, pp_axis='pp'):
         self.axes = dict(axes)
         self.data_axis = data_axis if data_axis in self.axes else None
         self.fsdp_axis = fsdp_axis if fsdp_axis in self.axes else None
         self.tp_axis = tp_axis if tp_axis in self.axes else None
+        # pp shards TIME (pipeline stages), never tensors: no role
+        # below ever names it, so batch/param/embeddings specs are
+        # identical with or without a pp axis in the mesh
+        self.pp_axis = pp_axis if pp_axis in self.axes else None
         # embed_pad: row-shard lookup tables whose height does NOT
         # divide, relying on the embedding engine's sentinel-row
         # padding (distributed/embedding_engine.pad_height).  The

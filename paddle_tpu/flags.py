@@ -330,8 +330,9 @@ DEFINE_string('mesh', '',
               'SPMD device mesh for whole-train-step pjit lowering, as '
               'comma-separated axis=size pairs over the canonical axis '
               'vocabulary dp (data), fsdp (params+optimizer-state '
-              'sharding), tp (tensor parallel): e.g. "dp=2", '
-              '"dp=4,tp=2", "fsdp=8".  When set, the executor builds a '
+              'sharding), tp (tensor parallel), pp (pipeline stages): '
+              'e.g. "dp=2", "dp=4,tp=2", "fsdp=8", or the compact '
+              'form "pp2,fsdp2".  When set, the executor builds a '
               'jax Mesh over the first prod(sizes) devices, the '
               'sharding-propagation pass (transpiler/sharding.py) '
               'stamps per-op input/output PartitionSpecs on the plan '
@@ -341,7 +342,10 @@ DEFINE_string('mesh', '',
               'parameter AND its optimizer accumulators, tp follows '
               'the TensorParallelTranspiler plan, and gradient '
               'allreduce lowers to ICI collectives inside the one '
-              'compiled step.  Empty (default) is off — bitwise the '
+              'compiled step.  A pp axis routes through the 1F1B '
+              'engine instead (distributed/pipeline.from_mesh) — the '
+              'plain SPMD path refuses it with an actionable error.  '
+              'Empty (default) is off — bitwise the '
               'pre-mesh executor.  Re-read per plan build and part of '
               'the composite plan-cache key, so flips take effect '
               'without a restart.  CPU smoke: force host devices with '
@@ -462,6 +466,35 @@ DEFINE_float('hbm_gbps', 0.0,
              'bytes / this.  0 (default) falls back to 819 GB/s '
              '(v5e HBM).  Only affects modeled numbers — reports, '
              'priors, pruning — never measured ones')
+DEFINE_bool('overlap', True,
+            'collective-overlap scheduling pass (transpiler/overlap.py,'
+            ' registered as overlap_collectives): under a PADDLE_TPU_'
+            'MESH with a data/fsdp axis, partition parameter-gradient '
+            'allreduce/reduce-scatter into size-bounded buckets '
+            '(PADDLE_TPU_OVERLAP_BUCKET_MB) ordered by backward '
+            'retirement, group each bucket with an optimization '
+            'barrier so XLA fires its collective as soon as the last '
+            'producing backward op retires (concurrent with remaining '
+            'backward compute), and report overlapped-vs-exposed '
+            'comm bytes in the cost model and the collective step '
+            'phase.  0 restores the inline-after-backward lowering '
+            'bitwise.  dp=1 / no-mesh programs are never touched')
+DEFINE_int('overlap_bucket_mb', 25,
+           'gradient-bucket payload cap in MiB for the '
+           'overlap_collectives pass: smaller buckets fire earlier '
+           '(more overlap window) but pay more per-collective latency;'
+           ' larger buckets amortize launch cost but serialize behind '
+           'the last grad in the bucket.  25 is the PyTorch-DDP '
+           'convention the pass defaults to.  A registered tunable '
+           '(tuning/registry.py) the mesh benches can search')
+DEFINE_int('pp_microbatches', 4,
+           'microbatch count M for the pp mesh axis (1F1B pipeline '
+           'schedule): the global batch splits into M microbatches '
+           'flowing through S=pp stages, with modeled bubble fraction '
+           '(S-1)/(M+S-1) reported by the cost model.  Larger M '
+           'shrinks the bubble but shrinks per-microbatch work.  '
+           'Read by distributed/pipeline.from_mesh and the sharding '
+           'pass pp plan block; a registered tunable')
 DEFINE_string('compilation_cache_dir', '',
               'opt-in persistent XLA compilation cache directory: compiled '
               'executables (Executor plans, serving warmup buckets) are '

@@ -430,32 +430,156 @@ ICI_BASIS = ('ring collectives: allreduce moves 2(N-1)/N x payload '
              'all-gather ring); reduce_scatter / all_gather move '
              '(N-1)/N each; all_to_all keeps 1/N local and moves '
              '(N-1)/N (the sharded-embedding lookup pays two: id '
-             'buckets out, gathered rows back)')
+             'buckets out, gathered rows back); ppermute moves the '
+             'payload once across one link.  bytes.exposed is the '
+             'portion modeled as serial with compute: everything, '
+             'unless the overlap_collectives bucket schedule (grad '
+             'collectives vs remaining backward) or the 1F1B tick '
+             'model (pp ppermute vs stage compute) hides it')
+
+# modeled ICI bandwidth fallback for the overlap schedule when
+# PADDLE_TPU_ICI_GBPS is unset: ~one v5e ICI link.  Only modeled
+# numbers (exposed/overlapped split, schedule seconds) use it — the
+# executor's est_wall_s still requires the explicit flag
+DEFAULT_ICI_GBPS = 100.0
 
 
-def _collective_costs(program):
+def _modeled_ici_gbps():
+    from ..flags import FLAGS
+    g = float(FLAGS.ici_gbps or 0.0)
+    return g if g > 0 else DEFAULT_ICI_GBPS
+
+
+def overlap_schedule(buckets, backward_s, window_s, bw_bps):
+    """Serial-comm-channel schedule of the bucket collectives against
+    the compute they can hide behind: bucket b's collective issues at
+    max(ready_frac_b * backward_s, prior bucket done) and may overlap
+    until ``window_s`` — the end of backward PLUS the optimizer
+    updates, since a bucket's allreduce only blocks ITS OWN params'
+    updates (the jaxpr carries no edge to the others').  The
+    **exposed** portion is whatever of a transfer runs past the
+    window.  Pure arithmetic over the stamped bucket descriptors, so
+    the executor can re-run it with measured walls."""
+    window_s = max(window_s, backward_s)
+    t_prev_end = 0.0
+    sched = []
+    exposed_ici = 0
+    total_ici = 0
+    for b in buckets:
+        dur = b['ici_bytes'] / bw_bps
+        start = max(b['ready_frac'] * backward_s, t_prev_end)
+        end = start + dur
+        exp_s = max(0.0, end - window_s) - max(0.0, start - window_s)
+        exp_b = min(int(round(exp_s * bw_bps)), b['ici_bytes'])
+        exposed_ici += exp_b
+        total_ici += b['ici_bytes']
+        sched.append({
+            'names': b['names'], 'bytes': b['bytes'],
+            'ici_bytes': b['ici_bytes'],
+            'ready_frac': b['ready_frac'],
+            'start_s': round(start, 9), 'end_s': round(end, 9),
+            'exposed_bytes': exp_b,
+        })
+        t_prev_end = end
+    frac = ((total_ici - exposed_ici) / total_ici) if total_ici else 0.0
+    return {
+        'buckets': sched,
+        'backward_s': round(backward_s, 9),
+        'window_s': round(window_s, 9),
+        'ici_gbps': bw_bps / 1e9,
+        'total_ici_bytes': int(total_ici),
+        'exposed_bytes': int(exposed_ici),
+        'overlapped_bytes': int(total_ici - exposed_ici),
+        'overlap_fraction': round(frac, 6),
+    }
+
+
+def _pp_exposure(pp, pp_items, compute_s, bw_bps):
+    """1F1B tick model for the boundary ppermute sends: a send hides
+    behind the OTHER microbatches' compute on its stage, so only the
+    part of one send exceeding one stage-tick of compute is exposed.
+    Each boundary carries 2M sends per step (activations forward,
+    cotangents backward)."""
+    stages = max(int(pp.get('stages') or 1), 1)
+    micro = max(int(pp.get('microbatches') or 1), 1)
+    sends = 2 * micro
+    tick_s = compute_s / stages / sends if compute_s else 0.0
+    total_ici = 0
+    exposed_ici = 0
+    for it in pp_items:
+        total_ici += it['ici_bytes']
+        send_s = it['ici_bytes'] / sends / bw_bps
+        exp_s = max(0.0, send_s - tick_s) * sends
+        exposed_ici += min(int(round(exp_s * bw_bps)), it['ici_bytes'])
+    return {
+        'stages': stages,
+        'microbatches': micro,
+        'bubble_fraction': pp.get('bubble_fraction'),
+        'cuts': pp.get('cuts'),
+        'ppermute_ici_bytes': int(total_ici),
+        'exposed_bytes': int(exposed_ici),
+        'overlapped_bytes': int(total_ici - exposed_ici),
+    }
+
+
+def _collective_costs(program, backward_s=0.0, compute_s=0.0,
+                      update_s=0.0):
     """Price the sharding pass's collective table with the ring closed
     forms — the **collective cost term**: per-step bytes each device
     moves over ICI, attributed per collective op.  None when the
-    program was not sharded (single-device plans carry no comm)."""
+    program was not sharded (single-device plans carry no comm); a
+    sharded plan with an EMPTY table returns the structured zero dict
+    (``bytes`` = {total, exposed, overlapped}), not None — the
+    old ``ici_bytes`` scalar stays for BENCH JSON compatibility."""
     plan = getattr(program, '_sharding_plan', None)
-    if not plan or not plan.get('collectives'):
+    if not plan:
         return None
     from . import sharding as _sh
     items = []
     total = 0
     by_kind = {}
-    for it in plan['collectives']:
+    for it in plan.get('collectives') or ():
         ici = _sh.collective_ici_bytes(it['kind'], it['n'], it['bytes'])
         items.append(dict(it, ici_bytes=ici))
         total += ici
         by_kind[it['kind']] = by_kind.get(it['kind'], 0) + ici
+
+    bw_bps = _modeled_ici_gbps() * 1e9
+    ov = plan.get('overlap')
+    schedule = None
+    if ov and ov.get('buckets'):
+        bwd_s = max(backward_s, 0.0)
+        schedule = overlap_schedule(ov['buckets'], bwd_s,
+                                    bwd_s + max(update_s, 0.0), bw_bps)
+        schedule['bucket_mb'] = ov['bucket_mb']
+    pp = plan.get('pp')
+    pp_term = None
+    pp_items = [i for i in items if i['kind'] == 'ppermute']
+    if pp:
+        pp_term = _pp_exposure(pp, pp_items, max(compute_s, 0.0),
+                               bw_bps)
+
+    # the structured split: serial (pre-pass) attribution for every
+    # collective outside a modeled overlap window
+    exposed = total
+    if schedule:
+        exposed -= schedule['overlapped_bytes']
+    if pp_term:
+        exposed -= pp_term['overlapped_bytes']
+    exposed = max(0, min(exposed, total))
     return {
         'basis': ICI_BASIS,
         'mesh_axes': tuple(plan.get('mesh_axes') or ()),
         'items': items,
         'by_kind': by_kind,
         'ici_bytes': int(total),
+        'bytes': {'total': int(total), 'exposed': int(exposed),
+                  'overlapped': int(total - exposed)},
+        'overlap': schedule,
+        'pp': pp_term,
+        # the whole-step modeled compute floor: the scale reference
+        # the executor uses to re-run the schedule with measured walls
+        'modeled_compute_s': round(max(compute_s, 0.0), 9),
     }
 
 
@@ -561,7 +685,22 @@ def analyze_cost(program, fetch_names=(), feed_specs=None):
         _spec_bytes((tuple(v.shape), v.dtype), unk)
         for v in program.list_vars() if v.persistable and v.shape)
 
-    collectives = _collective_costs(program)
+    # modeled compute windows the collective schedule overlaps against:
+    # whole-step and backward-role roofline floors (the same calibrated
+    # fallbacks tuning/roofline.py uses)
+    from ..tuning.roofline import resolved_peak_tflops, resolved_hbm_gbps
+    peak_fs = float(resolved_peak_tflops()) * 1e12
+    hbm_bs = float(resolved_hbm_gbps()) * 1e9
+    bwd = per_role.get('backward') or {}
+    opt = per_role.get('optimize') or {}
+    backward_s = max(bwd.get('flops', 0) / peak_fs,
+                     bwd.get('bytes', 0) / hbm_bs)
+    update_s = max(opt.get('flops', 0) / peak_fs,
+                   opt.get('bytes', 0) / hbm_bs)
+    compute_s = max(total_flops / peak_fs, total_bytes / hbm_bs)
+    collectives = _collective_costs(program, backward_s=backward_s,
+                                    compute_s=compute_s,
+                                    update_s=update_s)
 
     return {
         'collectives': collectives,

@@ -237,6 +237,20 @@ def analyze_memory(program, fetch_names=(), feed_specs=None,
         for n in deaths.get(i, ()):
             live -= sizes[n]
 
+    # collective-overlap in-flight credit: while a bucket's allreduce /
+    # reduce-scatter runs concurrently with remaining backward compute,
+    # its gradient payload is pinned live NEXT TO the backward frontier
+    # — the serial model above would have retired it into the update.
+    # Charge the largest bucket (the comm channel runs buckets
+    # serially, so at most one is in flight at the peak).
+    overlap_bucket_bytes = 0
+    ov = (shard_plan or {}).get('overlap') if shard_plan else None
+    if ov and ov.get('buckets'):
+        overlap_bucket_bytes = max(
+            sum(sizes.get(n, 0) for n in b['names'])
+            for b in ov['buckets'])
+        peak += overlap_bucket_bytes
+
     watermark = sorted(per_op, key=lambda e: -e['live_bytes'])[:top_k]
     sharding_block = None
     if divisors:
@@ -250,6 +264,7 @@ def analyze_memory(program, fetch_names=(), feed_specs=None,
         'peak_bytes': int(peak),
         'peak_intermediate_bytes': int(
             peak_entry['intermediate_bytes'] if peak_entry else 0),
+        'overlap_bucket_bytes': int(overlap_bucket_bytes),
         'persistable_bytes': int(persistable_bytes),
         'feed_bytes': int(feed_bytes),
         'sharding': sharding_block,

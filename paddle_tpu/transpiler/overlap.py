@@ -1,0 +1,212 @@
+"""Collective-overlap scheduling pass (``overlap_collectives``).
+
+PR 12's sharding pass prices every gradient collective with the ring
+closed forms, but the lowered step still exposes them: one pjit program
+whose gradient allreduce/reduce-scatter all sit behind the LAST
+backward op, serial with nothing.  This pass applies the PyTorch-DDP /
+ZeRO bucketing design to the registered-pass pipeline:
+
+1. **Bucket** the parameter-gradient collectives (allreduce /
+   reduce_scatter entries of the sharding plan — never the forward
+   all_gathers or the embed all-to-alls, which have their own
+   schedules) into size-bounded buckets, capped at
+   ``PADDLE_TPU_OVERLAP_BUCKET_MB`` MiB of payload.
+
+2. **Order** buckets by backward retirement.  The backward pass
+   re-walks the loss-contributing forward slice in reverse, so the
+   gradient of a parameter is fully accumulated when the backward
+   reaches the EARLIEST forward op that reads it — last layers retire
+   first.  Each gradient's ``ready_frac`` is the fraction of modeled
+   backward compute (roofline per-op floors over the slice) already
+   done at that point; each bucket fires at the max of its members'.
+
+3. **Lower** donation-safely under the existing pjit program: the
+   executor groups every bucket's gradients with one
+   ``jax.lax.optimization_barrier`` (stamped here as the hashable
+   ``overlap_buckets`` attr on the autodiff op).  The barrier is an
+   identity — bitwise-identical values — but hands XLA's latency-hiding
+   scheduler a per-bucket dependency cut, so each bucket's collective
+   can issue as soon as its last producing backward op retires instead
+   of after the whole backward.
+
+The cost model prices the resulting schedule (exposed = max(0, comm −
+concurrent compute) per bucket window) and the executor reports the
+overlap fraction per step.  ``PADDLE_TPU_OVERLAP=0``, dp=1, and
+no-mesh programs are bitwise-identical to the pre-pass lowering: the
+pass stamps nothing.
+"""
+
+OVERLAP_BASIS = (
+    'DDP gradient bucketing: grads bucketed to <= bucket_mb MiB of '
+    'payload, ordered by backward retirement (grad of a param is '
+    'ready when the backward re-walk reaches the earliest forward op '
+    'reading it); each bucket is an optimization_barrier group so its '
+    'collective can overlap the backward compute still ahead of it')
+
+
+def overlap_enabled():
+    """The PADDLE_TPU_OVERLAP gate, re-read per plan build."""
+    from ..flags import FLAGS
+    return bool(FLAGS.overlap)
+
+
+def bucket_cap_bytes():
+    from ..flags import FLAGS
+    mb = int(FLAGS.overlap_bucket_mb or 0)
+    return max(1, mb) * (1 << 20)
+
+
+def overlap_plan_key():
+    """Plan-cache key component: both knobs that change what this pass
+    stamps (and with it the traced barrier structure)."""
+    if not overlap_enabled():
+        return ('overlap', 0)
+    from ..flags import FLAGS
+    return ('overlap', 1, int(FLAGS.overlap_bucket_mb or 0))
+
+
+def _forward_weights(program, ad_idx, loss_name, feed_specs):
+    """{op index: modeled time floor} for the loss-contributing forward
+    slice of the autodiff at ``ad_idx`` — the cost model's per-op
+    roofline floors (max of flops/peak, bytes/bw with the calibrated
+    fallbacks), the clock ``ready_frac`` is measured on.  Ops without a
+    cost verdict weigh 0; an all-zero slice degrades to uniform
+    weights (op count)."""
+    from . import cost_model as _cm
+    from ..tuning.roofline import resolved_peak_tflops, resolved_hbm_gbps
+    block = program.global_block()
+    ops = block.ops
+    batch = _cm._batch_binding(block, feed_specs)
+    env = {}
+    for n, (shape, dt) in (feed_specs or {}).items():
+        env[n] = (tuple(int(d) for d in shape), str(dt))
+    slice_idx = _cm._autodiff_slice(ops, ad_idx, loss_name)
+    in_slice = set(slice_idx)
+    peak = float(resolved_peak_tflops()) * 1e12
+    bw = float(resolved_hbm_gbps()) * 1e9
+    weights = {}
+    # walk in program order so declaration-less intermediates propagate
+    for i, op in enumerate(ops):
+        if i >= ad_idx:
+            break
+        if op.type == 'autodiff' or _cm._structurally_waived(op) or \
+                op.type in _cm.WAIVED_OPS:
+            continue
+        in_specs = _cm._resolve_in_specs(block, op, env, batch)
+        out_specs = _cm._out_specs(block, op, in_specs, env, batch)
+        if i not in in_slice:
+            continue
+        c = _cm.op_cost(op.type, in_specs, out_specs, op.attrs)
+        if c is None:
+            continue
+        weights[i] = max(c['flops'] / peak, c['bytes'] / bw)
+    if not any(weights.values()):
+        weights = {i: 1.0 for i in slice_idx}
+    return slice_idx, weights
+
+
+def _ready_fracs(program, ad_op, ad_idx, grad_to_param, feed_specs):
+    """{grad name: fraction of backward compute done when the grad is
+    fully accumulated}.  Backward processes the forward slice in
+    reverse program order; the grad of param p completes when it passes
+    the EARLIEST slice op reading p."""
+    block = program.global_block()
+    ops = block.ops
+    loss_name = ad_op.attrs.get('loss_name')
+    slice_idx, weights = _forward_weights(
+        program, ad_idx, loss_name, feed_specs)
+    total = sum(weights.get(j, 0.0) for j in slice_idx) or 1.0
+    # done_after[j]: backward weight completed once the reverse walk has
+    # processed every slice op with index >= j
+    fracs = {}
+    for gn, pn in grad_to_param.items():
+        reads = [j for j in slice_idx
+                 if pn in set(ops[j].input_arg_names)]
+        if not reads:
+            fracs[gn] = 1.0  # not on the modeled path: fires last
+            continue
+        j_min = min(reads)
+        done = sum(weights.get(j, 0.0) for j in slice_idx if j >= j_min)
+        fracs[gn] = min(1.0, done / total)
+    return fracs
+
+
+GRAD_COLLECTIVE_KINDS = ('allreduce', 'reduce_scatter')
+
+
+def apply_overlap(program, feed_specs=None):
+    """Stamp the bucket schedule on ``program`` (plan['overlap'] + the
+    ``overlap_buckets`` autodiff attr) and return the report fragment.
+    Stamps NOTHING — bitwise no-op — when the flag is off, the plan has
+    no gradient collectives, or there is no autodiff op."""
+    if not overlap_enabled():
+        return {'enabled': False, 'reason': 'PADDLE_TPU_OVERLAP=0'}
+    plan = getattr(program, '_sharding_plan', None)
+    if not plan or not plan.get('collectives'):
+        return {'enabled': False, 'reason': 'no collectives in plan'}
+    ops = program.global_block().ops
+    ad = [(i, op) for i, op in enumerate(ops) if op.type == 'autodiff']
+    if not ad:
+        return {'enabled': False, 'reason': 'no autodiff op'}
+    ad_idx, ad_op = ad[0]
+    grad_names = set(ad_op.attrs.get('grad_names') or ())
+    grad_to_param = {g: p for p, g in zip(ad_op.attrs['param_names'],
+                                          ad_op.attrs['grad_names'])}
+    grad_colls = [c for c in plan['collectives']
+                  if c['kind'] in GRAD_COLLECTIVE_KINDS
+                  and c['name'] in grad_names]
+    if not grad_colls:
+        return {'enabled': False, 'reason': 'no gradient collectives'}
+
+    fracs = _ready_fracs(program, ad_op, ad_idx,
+                         {c['name']: grad_to_param[c['name']]
+                          for c in grad_colls}, feed_specs)
+    # earliest-ready first; name tie-break keeps the schedule
+    # deterministic across dict orders
+    order = sorted(grad_colls,
+                   key=lambda c: (fracs[c['name']], c['name']))
+
+    from . import sharding as _sh
+    cap = bucket_cap_bytes()
+    buckets = []
+    cur = None
+    for c in order:
+        if cur is None or (cur['bytes'] + c['bytes'] > cap
+                           and cur['names']):
+            cur = {'names': [], 'bytes': 0, 'ici_bytes': 0,
+                   'kinds': set(), 'ready_frac': 0.0}
+            buckets.append(cur)
+        cur['names'].append(c['name'])
+        cur['bytes'] += int(c['bytes'])
+        cur['ici_bytes'] += _sh.collective_ici_bytes(
+            c['kind'], c['n'], c['bytes'])
+        cur['kinds'].add(c['kind'])
+        # the bucket fires when its LAST member retires
+        cur['ready_frac'] = max(cur['ready_frac'], fracs[c['name']])
+    bucket_tuples = tuple({
+        'names': tuple(b['names']),
+        'bytes': int(b['bytes']),
+        'ici_bytes': int(b['ici_bytes']),
+        'kinds': tuple(sorted(b['kinds'])),
+        'ready_frac': round(float(b['ready_frac']), 6),
+    } for b in buckets)
+
+    plan['overlap'] = {
+        'basis': OVERLAP_BASIS,
+        'bucket_mb': cap >> 20,
+        'buckets': bucket_tuples,
+        'grad_names': tuple(n for b in bucket_tuples
+                            for n in b['names']),
+    }
+    # hashable grouping the executor lowers with optimization_barrier;
+    # verify.py pins attr <-> plan consistency
+    ad_op.attrs['overlap_buckets'] = tuple(
+        b['names'] for b in bucket_tuples)
+    return {
+        'enabled': True,
+        'bucket_mb': cap >> 20,
+        'buckets': len(bucket_tuples),
+        'grads': len(grad_colls),
+        'max_bucket_bytes': max(b['bytes'] for b in bucket_tuples),
+        'ready_fracs': tuple(b['ready_frac'] for b in bucket_tuples),
+    }

@@ -211,6 +211,26 @@ def _embed_shard(program, ctx):
     return {'embed': sharding_mod.apply_embed_lowering(program)}
 
 
+def _overlap_enabled():
+    from . import overlap as overlap_mod
+    return overlap_mod.overlap_enabled()
+
+
+@register_pass('overlap_collectives', 88, 'overlap',
+               enabled=lambda cfg: bool(cfg.mesh) and _overlap_enabled())
+def _overlap_collectives(program, ctx):
+    # after sharding + embed lowering (it buckets the gradient entries
+    # of the finished collective table), before the analysis tail (the
+    # cost model prices the bucket schedule's exposed-vs-overlapped
+    # bytes, the memory model charges the in-flight bucket): order
+    # gradient allreduce/reduce-scatter into retirement-ordered
+    # size-bounded buckets and stamp the donation-safe grouping the
+    # executor lowers with optimization_barrier
+    from . import overlap as overlap_mod
+    return {'overlap': overlap_mod.apply_overlap(
+        program, feed_specs=ctx.feed_specs)}
+
+
 @register_pass('donation', 90, 'donation', kind='analysis',
                enabled=lambda cfg: cfg.level >= 1)
 def _donation(program, ctx):
@@ -278,10 +298,13 @@ def plan_key(program=None):
     from ..ops.pallas.dense_update import dense_apply_mode, \
         flat_tile_budget
     from .sharding import embed_plan_key
+    from .overlap import overlap_plan_key
+    from ..flags import FLAGS
     return ('pm', resolve_level(program), plan_key_component(),
             verify_mod.resolve_mode(None), sparse_apply_mode(),
             dense_apply_mode(), mesh_key(), embed_plan_key(),
-            flat_tile_budget())
+            flat_tile_budget(), overlap_plan_key(),
+            int(FLAGS.pp_microbatches or 0))
 
 
 # ---------------------------------------------------------------------------
@@ -422,6 +445,8 @@ def run_pipeline(program, fetch_names=(), feed_names=(), level=None,
             report['sharding'] = frag['sharding']
         if frag.get('embed') is not None:
             report['embed'] = frag['embed']
+        if frag.get('overlap') is not None:
+            report['overlap'] = frag['overlap']
         if frag.get('cost') is not None:
             report['cost'] = frag['cost']
         if frag.get('memory') is not None:

@@ -47,7 +47,7 @@ from . import cost_model as _cm
 
 __all__ = ['apply_sharding', 'apply_embed_lowering', 'RING_FACTORS',
            'collective_ici_bytes', 'embed_shard_enabled',
-           'embed_plan_key', 'EMBED_ROWWISE_OPS']
+           'embed_plan_key', 'EMBED_ROWWISE_OPS', 'select_pp_cuts']
 
 # closed-form ICI traffic factors, as a fraction of the payload bytes:
 # ring allreduce moves each byte out (reduce-scatter ring) and back
@@ -60,6 +60,9 @@ RING_FACTORS = {
     'reduce_scatter': lambda n: (n - 1) / n,
     'all_gather': lambda n: (n - 1) / n,
     'all_to_all': lambda n: (n - 1) / n,
+    # pipeline boundary send: the whole payload crosses one link once,
+    # independent of the stage count
+    'ppermute': lambda n: 1.0,
 }
 
 # op types allowed to carry embed_* attrs: the lookup itself plus the
@@ -266,6 +269,12 @@ def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
                 for n, s in spec_of.items()
                 if spec_divisor(s, axes_d) > 1}
 
+    pp = None
+    if layout.pp_axis and layout.axis_size(layout.pp_axis) > 1:
+        pp, pp_colls = _pp_plan(program, block, layout, batch,
+                                feed_specs)
+        collectives.extend(pp_colls)
+
     program._sharding_plan = {
         'mesh_axes': mesh_axes,
         'batch_axis': batch_axis,
@@ -279,9 +288,14 @@ def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
         # embed lowering pass) so the verifier can excuse the
         # pad-backed indivisible split the moment the spec exists
         'embed': embed,
+        # pipeline-parallel block (pp mesh axis): stage count S, the
+        # 1F1B microbatch count M, resolved stage-boundary cut vars,
+        # and the closed-form bubble fraction (S-1)/(M+S-1) the cost
+        # model reports.  None when the mesh has no pp axis
+        'pp': pp,
     }
 
-    return {
+    rep = {
         'mesh': mesh_axes,
         'batch_axis': batch_axis,
         'params_sharded': len(param_specs),
@@ -290,6 +304,128 @@ def apply_sharding(program, mesh_axes, fetch_names=(), feed_names=(),
         'sharded_names': len(divisors),
         'embed_tables': len(embed),
     }
+    if pp is not None:
+        rep['pp'] = {k: pp[k] for k in
+                     ('stages', 'microbatches', 'bubble_fraction',
+                      'cuts')}
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel (pp mesh axis) planning
+# ---------------------------------------------------------------------------
+
+def _forward_op_weights(block, batch, feed_specs):
+    """{op index: modeled time floor} over the forward prefix (every op
+    before the first autodiff) — the clock stage balancing cuts
+    against.  Degrades to uniform weights when no op has a cost
+    verdict."""
+    from ..tuning.roofline import resolved_peak_tflops, resolved_hbm_gbps
+    peak = float(resolved_peak_tflops()) * 1e12
+    bw = float(resolved_hbm_gbps()) * 1e9
+    env = {}
+    for n, (shape, dt) in (feed_specs or {}).items():
+        env[n] = (tuple(int(d) for d in shape), str(dt))
+    weights = {}
+    for i, op in enumerate(block.ops):
+        if op.type == 'autodiff':
+            break
+        weights[i] = 0.0
+        if _cm._structurally_waived(op) or op.type in _cm.WAIVED_OPS:
+            continue
+        in_specs = _cm._resolve_in_specs(block, op, env, batch)
+        out_specs = _cm._out_specs(block, op, in_specs, env, batch)
+        c = _cm.op_cost(op.type, in_specs, out_specs, op.attrs)
+        if c is not None:
+            weights[i] = max(c['flops'] / peak, c['bytes'] / bw)
+    if not any(weights.values()):
+        weights = {i: 1.0 for i in weights}
+    return weights
+
+
+def select_pp_cuts(program, names, stages, feed_specs=None):
+    """Pick ``stages - 1`` stage boundaries from the annotated
+    candidate vars, balancing cumulative modeled forward cost: the
+    j-th cut lands on the candidate whose forward prefix weight is
+    closest to j/S of the total (strictly increasing program order, so
+    stages never empty).  Over-annotate freely — e.g. one candidate
+    per layer — and let the mesh's S choose."""
+    block = program.global_block()
+    batch = _cm._batch_binding(block, feed_specs)
+    prod = {}
+    wanted = set(names)
+    for i, op in enumerate(block.ops):
+        for n in op.output_arg_names:
+            if n in wanted and n not in prod:
+                prod[n] = i
+    cands = sorted((n for n in names if n in prod),
+                   key=lambda n: prod[n])
+    need = int(stages) - 1
+    if len(cands) < need:
+        return None
+    if len(cands) == need:
+        return tuple(cands)
+    weights = _forward_op_weights(block, batch, feed_specs)
+    total = sum(weights.values()) or 1.0
+    prefix = {n: sum(w for i, w in weights.items() if i <= prod[n])
+              for n in cands}
+    cuts = []
+    lo = 0  # candidates before this index are used up
+    for j in range(1, need + 1):
+        target = j * total / (int(stages))
+        # leave enough candidates for the remaining cuts
+        hi = len(cands) - (need - j)
+        pool = cands[lo:hi]
+        best = min(pool, key=lambda n: (abs(prefix[n] - target),
+                                        prod[n]))
+        cuts.append(best)
+        lo = cands.index(best) + 1
+    return tuple(cuts)
+
+
+def _pp_plan(program, block, layout, batch, feed_specs):
+    """The plan's ``pp`` block + the boundary ppermute collectives.
+
+    Each microbatch crosses each stage boundary twice per step — its
+    activation forward and its cotangent backward — so a boundary's
+    modeled ppermute payload is 2x the batch-sized cut var."""
+    from ..flags import FLAGS
+    stages = layout.axis_size(layout.pp_axis)
+    micro = max(int(FLAGS.pp_microbatches or 1), 1)
+    bubble = (stages - 1) / float(micro + stages - 1)
+    annotated = tuple(getattr(program, '_pp_cut_names', ()) or ())
+    pp = {
+        'axis': layout.pp_axis,
+        'stages': stages,
+        'microbatches': micro,
+        # the 1F1B closed form: (S-1) of (M+S-1) schedule ticks are
+        # ramp-up/drain where some stage idles
+        'bubble_fraction': round(bubble, 6),
+        'annotated': annotated,
+        'cuts': None,
+    }
+    colls = []
+    cuts = select_pp_cuts(program, annotated, stages,
+                          feed_specs=feed_specs) if annotated else None
+    if cuts is None:
+        pp['note'] = (
+            '%d stage boundaries needed but %d annotated cut vars '
+            'resolve to producing ops — annotate boundary activations '
+            'with distributed.pipeline.annotate_pp_cut, then lower '
+            'with distributed.pipeline.from_mesh'
+            % (stages - 1, len(annotated)))
+        return pp, colls
+    pp['cuts'] = cuts
+    # dp replicas of the pipeline each carry only their batch shard
+    # across the boundary, so the per-device payload divides
+    bdiv = layout.axis_size(layout.batch_axis) if layout.batch_axis \
+        else 1
+    for n in cuts:
+        cb = _var_bytes(block, n, batch) // max(bdiv, 1)
+        colls.append({'name': n, 'kind': 'ppermute',
+                      'axis': layout.pp_axis, 'n': stages,
+                      'bytes': 2 * cb})
+    return pp, colls
 
 
 def _axis_label(entry):

@@ -696,6 +696,79 @@ def _check_embed(program, plan, errors):
 
 
 # ---------------------------------------------------------------------------
+# collective-overlap bucket schedule (transpiler/overlap.py)
+# ---------------------------------------------------------------------------
+
+def _check_overlap(program, errors):
+    """Overlap-pass invariants: the ``overlap_buckets`` attr may sit
+    only on an autodiff op, must mirror the plan's bucket schedule
+    exactly, and the schedule itself must partition the gradient-
+    collective names (each bucketed grad backed by exactly one
+    allreduce/reduce_scatter entry, bucket byte sums matching the
+    table, ready fractions monotone — the bucket order IS the firing
+    order)."""
+    plan = getattr(program, '_sharding_plan', None)
+    ov = (plan or {}).get('overlap')
+    block = program.global_block()
+    attr_ops = [(i, op) for i, op in enumerate(block.ops)
+                if op.attrs.get('overlap_buckets') is not None]
+    for i, op in attr_ops:
+        if op.type != 'autodiff':
+            errors.append(
+                "%s carries overlap_buckets but is not an autodiff op "
+                "— the overlap pass groups gradients only"
+                % _op_str(0, i, op))
+    if ov is None:
+        for i, op in attr_ops:
+            if op.type == 'autodiff':
+                errors.append(
+                    "%s carries overlap_buckets but the sharding plan "
+                    "has no overlap block — attr and plan must be "
+                    "stamped together" % _op_str(0, i, op))
+        return
+    buckets = ov.get('buckets') or ()
+    plan_names = tuple(n for b in buckets for n in b['names'])
+    if len(set(plan_names)) != len(plan_names):
+        errors.append("overlap plan buckets repeat a gradient name — "
+                      "buckets must partition the grad set")
+    from . import overlap as _ov_mod
+    table = {}
+    for c in (plan.get('collectives') or ()):
+        if c['kind'] in _ov_mod.GRAD_COLLECTIVE_KINDS:
+            table.setdefault(c['name'], 0)
+            table[c['name']] += int(c['bytes'])
+    prev_frac = 0.0
+    for k, b in enumerate(buckets):
+        ghost = [n for n in b['names'] if n not in table]
+        if ghost:
+            errors.append(
+                "overlap bucket #%d names %r with no gradient "
+                "allreduce/reduce_scatter entry in the collective "
+                "table" % (k, ghost))
+            continue
+        want = sum(table[n] for n in b['names'])
+        if int(b['bytes']) != want:
+            errors.append(
+                "overlap bucket #%d claims %d payload bytes but its "
+                "members' collective entries sum to %d"
+                % (k, int(b['bytes']), want))
+        if b['ready_frac'] < prev_frac - 1e-9:
+            errors.append(
+                "overlap bucket #%d ready_frac %.6f precedes bucket "
+                "#%d's %.6f — the schedule must fire in retirement "
+                "order" % (k, b['ready_frac'], k - 1, prev_frac))
+        prev_frac = max(prev_frac, b['ready_frac'])
+    ad_attrs = [tuple(op.attrs['overlap_buckets'])
+                for _i, op in attr_ops if op.type == 'autodiff']
+    want_attr = tuple(b['names'] for b in buckets)
+    if buckets and want_attr not in ad_attrs:
+        errors.append(
+            "sharding plan carries an overlap bucket schedule but no "
+            "autodiff op's overlap_buckets attr mirrors it — the "
+            "executor would lower without the barrier grouping")
+
+
+# ---------------------------------------------------------------------------
 # donation / in-place aliasing order safety
 # ---------------------------------------------------------------------------
 
@@ -806,6 +879,7 @@ def verify_program(program, fetch_names=(), feed_names=(),
     if amp_low:
         _check_amp(program, amp_low, errors)
     _check_sharding(program, errors)
+    _check_overlap(program, errors)
     _check_donation_order(program, feed_names, errors)
     return errors
 

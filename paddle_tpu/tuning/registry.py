@@ -61,7 +61,7 @@ class Tunable(object):
 
     def coerce(self, raw):
         """Parse an env-var string back to this tunable's value type."""
-        if isinstance(self.default, bool):  # pragma: no cover - unused
+        if isinstance(self.default, bool):
             return raw.lower() in ('1', 'true', 'yes', 'on')
         return type(self.default)(raw)
 
@@ -189,15 +189,18 @@ def base_env():
 
 def _mesh_feasible(spec):
     """A mesh candidate is feasible when the devices exist."""
+    s = str(spec or '').strip()
+    if not s:
+        return True
+    try:
+        # ONE spec vocabulary: axis=size and compact axisN both parse
+        from ..distributed.spec_layout import parse_mesh_spec
+        axes = parse_mesh_spec(s)
+    except ValueError:
+        return False
     n = 1
-    for piece in str(spec or '').split(','):
-        piece = piece.strip()
-        if not piece:
-            continue
-        try:
-            n *= max(int(piece.split('=', 1)[1]), 1)
-        except (IndexError, ValueError):
-            return False
+    for _, size in axes:
+        n *= max(int(size), 1)
     if n <= 1:
         return True
     try:
@@ -255,6 +258,27 @@ register_tunable(
     default=8, subsystem='inference.batching',
     env='PADDLE_TPU_SERVING_MAX_BATCH',
     help='serving bucket-ladder top (powers of two up to this)')
+register_tunable(
+    'overlap', (False, True),
+    default=True, subsystem='transpiler.overlap',
+    env='PADDLE_TPU_OVERLAP',
+    help='collective-overlap scheduling pass on/off: bucket gradient '
+         'allreduces and fire each as soon as its grads retire from '
+         'the backward (off = one serial comm phase at the end)')
+register_tunable(
+    'overlap_bucket_mb', (4, 8, 16, 25, 50, 100),
+    default=25, subsystem='transpiler.overlap',
+    env='PADDLE_TPU_OVERLAP_BUCKET_MB',
+    help='gradient-bucket size cap for the overlap pass: smaller '
+         'buckets start communicating earlier but pay more per-op '
+         'latency; larger ones amortize it but expose the tail')
+register_tunable(
+    'pp_microbatches', (2, 4, 8, 16, 32),
+    default=4, subsystem='distributed.pipeline',
+    env='PADDLE_TPU_PP_MICROBATCHES',
+    help='microbatches per pipelined step: more shrink the 1F1B '
+         'bubble (S-1)/(M+S-1) but each microbatch must still fill '
+         'the MXU, and the batch must split evenly')
 register_tunable(
     'train_batch', (16, 32, 64, 128, 256, 512),
     default=64, subsystem='bench', env='PADDLE_TPU_BENCH_BATCH',

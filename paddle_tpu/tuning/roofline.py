@@ -46,7 +46,11 @@ def _resources(peak_tflops, hbm_gbps):
 
 def _collective_s(cost, ici_gbps=None):
     coll = cost.get('collectives') or {}
-    ici_bytes = coll.get('ici_bytes') or 0
+    # the overlap schedule's exposed split when present (bytes hidden
+    # behind backward compute cost no serial step time); the raw total
+    # otherwise — the pre-overlap serial attribution
+    split = coll.get('bytes') or {}
+    ici_bytes = split.get('exposed', coll.get('ici_bytes')) or 0
     if ici_gbps is None:
         from ..flags import FLAGS
         ici_gbps = float(FLAGS.ici_gbps or 0.0)

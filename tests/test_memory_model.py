@@ -267,3 +267,54 @@ def test_waivers_name_real_ops():
             "memory_model.WAIVED_OPS entry %r does not name a "
             "registered op" % t)
     assert 'autodiff' not in memory_model.WAIVED_OPS
+
+
+# -- collective-overlap in-flight credit ----------------------------------
+
+def _mesh_mem(monkeypatch, overlap, level=None):
+    from paddle_tpu.transpiler import pass_manager as pm
+    monkeypatch.setenv('PADDLE_TPU_OVERLAP', overlap)
+    monkeypatch.setenv('PADDLE_TPU_OVERLAP_BUCKET_MB', '1')
+    main, _startup, loss = _train_program()
+    if level is not None:
+        fluid.memory_optimize(main, level=level)
+    prog, rep = pm.run_pipeline(
+        main, fetch_names=(loss.name,), feed_names=tuple(_TRAIN_SPECS),
+        feed_specs=_TRAIN_SPECS, mesh='dp=2', verify='boundary')
+    return prog, rep['cost']['memory']
+
+
+# all four grads fit one 1 MB bucket; dp leaves params unsharded so the
+# in-flight payload is the full f32 gradient byte count:
+#   fc_0.w_0[32,64] + fc_0.b_0[64] + fc_1.w_0[64,10] + fc_1.b_0[10]
+_GRAD_BYTES = (32 * 64 + 64 + 64 * 10 + 10) * 4
+
+
+def test_overlap_bucket_charges_peak_exactly(monkeypatch):
+    """While a bucket's allreduce overlaps remaining backward compute
+    its gradient payload stays live next to the backward frontier: the
+    model charges the LARGEST bucket (serial comm channel — one in
+    flight at a time) on top of the serial-walk peak, exactly."""
+    prog, mem_on = _mesh_mem(monkeypatch, '1')
+    _p, mem_off = _mesh_mem(monkeypatch, '0')
+    assert mem_off['overlap_bucket_bytes'] == 0
+    assert mem_on['overlap_bucket_bytes'] == _GRAD_BYTES
+    assert mem_on['peak_bytes'] == \
+        mem_off['peak_bytes'] + _GRAD_BYTES
+    # the credit agrees with the schedule's own bucket accounting
+    buckets = prog._sharding_plan['overlap']['buckets']
+    assert max(sum(b['bytes'] for b in (bk,)) for bk in buckets) == \
+        max(b['bytes'] for b in buckets) == _GRAD_BYTES
+
+
+def test_overlap_credit_composes_with_remat(monkeypatch):
+    """memory_optimize's remat shrinks the serial walk but the
+    in-flight bucket rides on top unchanged — gradients are not
+    rematerializable intermediates."""
+    _p, dots_on = _mesh_mem(monkeypatch, '1', level='dots')
+    _p2, dots_off = _mesh_mem(monkeypatch, '0', level='dots')
+    _p3, full_on = _mesh_mem(monkeypatch, '1')
+    assert dots_on['overlap_bucket_bytes'] == _GRAD_BYTES
+    assert dots_on['peak_bytes'] == \
+        dots_off['peak_bytes'] + _GRAD_BYTES
+    assert dots_on['peak_bytes'] <= full_on['peak_bytes']

@@ -31,16 +31,17 @@ def test_registered_passes_surface():
     from paddle_tpu.transpiler import pass_manager as pm
     names = [p.name for p in pm.registered_passes()]
     assert names == ['dce', 'constant_fold', 'cse', 'dce_sweep', 'amp',
-                     'sharding', 'embed_shard', 'donation',
-                     'cost_model', 'memory_model']
+                     'sharding', 'embed_shard', 'overlap_collectives',
+                     'donation', 'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(1, None)] == [
         'dce', 'donation', 'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(0, 'bf16')] == ['amp']
     assert [p.name for p in pm.build_plan(2, 'bf16')] == [
         'dce', 'constant_fold', 'cse', 'dce_sweep', 'amp', 'donation',
         'cost_model', 'memory_model']
-    # the sharding + embed-lowering passes join only under a mesh
+    # the sharding + embed-lowering + overlap passes join only under
+    # a mesh (overlap additionally gated by PADDLE_TPU_OVERLAP)
     assert [p.name for p in pm.build_plan(1, None, (('dp', 2),))] == [
-        'dce', 'sharding', 'embed_shard', 'donation', 'cost_model',
-        'memory_model']
+        'dce', 'sharding', 'embed_shard', 'overlap_collectives',
+        'donation', 'cost_model', 'memory_model']
     assert [p.name for p in pm.build_plan(0, None)] == []

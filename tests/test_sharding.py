@@ -518,3 +518,46 @@ def test_parallel_do_program_keeps_legacy_path(monkeypatch):
                                       outputs={}, attrs={})
         main._bump_version()
         assert exe._spmd_mesh(main) is None
+
+
+def test_overlap_buckets_exclude_embed_all_to_alls(monkeypatch):
+    """Composition pin: overlap_collectives (order 88) runs after
+    embed_shard (order 87) and must bucket ONLY the parameter-gradient
+    allreduce/reduce-scatters — the embedding lookup's two all_to_all
+    entries are forward-path traffic with no backward window to hide
+    in, so they stay out of every bucket but remain priced in the
+    collective total."""
+    monkeypatch.setenv('PADDLE_TPU_OVERLAP_BUCKET_MB', '1')
+    main, startup = fluid.Program(), fluid.Program()
+    with reset_unique_name_guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(input=ids, size=[64, 16],
+                                     is_sparse=False, param_attr='tbl')
+        h = fluid.layers.fc(input=emb, size=8, act='relu')
+        loss = fluid.layers.mean(x=h)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    prog, rep = pm.run_pipeline(
+        main, fetch_names=(loss.name,), feed_names=('ids',),
+        feed_specs={'ids': ((B, 1), 'int32')}, mesh='fsdp=4',
+        verify='every_pass')
+    plan = prog._sharding_plan
+    a2a = [c for c in plan['collectives'] if c['kind'] == 'all_to_all']
+    assert len(a2a) == 2, plan['collectives']
+    sched = plan.get('overlap')
+    assert sched and sched['buckets'], rep.get('overlap')
+    bucketed = {n for b in sched['buckets'] for n in b['names']}
+    assert bucketed, sched
+    assert bucketed.isdisjoint({c['name'] for c in a2a})
+    # every bucketed collective is a gradient reduction by kind
+    by_name = {c['name']: c for c in plan['collectives']}
+    for n in bucketed:
+        assert by_name[n]['kind'] in ('allreduce', 'reduce_scatter')
+    # the split stays coherent with the a2a traffic folded in: the
+    # all_to_alls can never be credited as overlapped
+    coll = rep['cost']['collectives']
+    split = coll['bytes']
+    assert split['exposed'] + split['overlapped'] == split['total']
+    a2a_ici = sum(c.get('ici_bytes', c['bytes']) for c in a2a)
+    assert split['exposed'] >= min(a2a_ici, split['total'] -
+                                   split['overlapped'])
+    assert split['total'] == coll['ici_bytes']

@@ -275,6 +275,14 @@ def _mut_corrupt_sharding_axis(program):
             return
 
 
+def _mut_stamp_overlap_on_non_autodiff(program):
+    # stamp a bucket grouping on an op that is not an autodiff — the
+    # barrier lowering only exists inside the autodiff closure, so the
+    # overlap-consistency check must catch and attribute it
+    op = program.global_block().ops[0]
+    op.attrs['overlap_buckets'] = (('__ghost__@GRAD',),)
+
+
 def _mut_stamp_embed_on_non_rowwise(program):
     # stamp embed routing attrs on an op that is neither a lookup nor
     # a row-wise sparse apply — such a consumer would scan the whole
@@ -297,6 +305,7 @@ PASS_MUTATIONS = {
     'amp': _mut_duplicate_weaver_cast,
     'sharding': _mut_corrupt_sharding_axis,
     'embed_shard': _mut_stamp_embed_on_non_rowwise,
+    'overlap_collectives': _mut_stamp_overlap_on_non_autodiff,
 }
 
 
@@ -304,8 +313,9 @@ PASS_MUTATIONS = {
 def test_mutation_is_caught_and_attributed(pass_name, monkeypatch):
     main, fetch = _data_program()
     amp = 'bf16' if pass_name == 'amp' else '0'
-    # the sharding + embed passes only join the plan under a mesh
-    mesh = 'dp=2' if pass_name in ('sharding', 'embed_shard') else ''
+    # the sharding + embed + overlap passes only join under a mesh
+    mesh = 'dp=2' if pass_name in ('sharding', 'embed_shard',
+                                   'overlap_collectives') else ''
     # control: the uncorrupted pipeline verifies clean at every_pass
     pm.run_pipeline(main, fetch_names=(fetch,), feed_names=('x',),
                     level=2, amp_mode=amp, mesh=mesh,

@@ -45,6 +45,36 @@ def _mutation_matrix_keys():
     return None
 
 
+# passes whose invariants the static verifier owns a dedicated hook
+# for: the hook must be DEFINED in transpiler/verify.py and CALLED
+# from verify_program, or the pass ships unverified (the mutation
+# matrix would still inject a corruption, but nothing would catch it)
+_REQUIRED_VERIFY_HOOKS = {
+    'sharding': '_check_sharding',
+    'overlap_collectives': '_check_overlap',
+    'donation': '_check_donation_order',
+}
+
+
+def _verify_program_calls():
+    """(defined function names, function names called inside
+    verify_program) for transpiler/verify.py, read statically."""
+    path = os.path.join(_REPO, 'paddle_tpu', 'transpiler', 'verify.py')
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    defined = {n.name for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)}
+    called = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == 'verify_program':
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    called.add(sub.func.id)
+    return defined, called
+
+
 def check():
     """Returns a list of human-readable error strings (empty = OK)."""
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
@@ -109,6 +139,21 @@ def check():
             errors.append(
                 "PASS_MUTATIONS entry %r does not name a registered "
                 "pass (renamed or removed?)" % n)
+
+    defined, called = _verify_program_calls()
+    for pass_name, hook in sorted(_REQUIRED_VERIFY_HOOKS.items()):
+        if pass_name not in pm.PASSES:
+            errors.append(
+                "verify hook table names unregistered pass %r" % pass_name)
+        if hook not in defined:
+            errors.append(
+                "pass %r: verify hook %s() is not defined in "
+                "transpiler/verify.py" % (pass_name, hook))
+        elif hook not in called:
+            errors.append(
+                "pass %r: verify hook %s() is defined but never "
+                "called from verify_program — the pass's invariants "
+                "go unchecked" % (pass_name, hook))
     return errors
 
 

@@ -33,6 +33,15 @@ sys.path.insert(0, _REPO)
 
 _MAX_DOMAIN = 64
 
+# knobs that MUST stay registered — hand-set constants the PRs that
+# introduced them promised to the autotuner.  Deleting a registration
+# silently un-tunes the knob (the flag keeps working, the search just
+# stops seeing it), so the lint pins a floor under the registry.
+_REQUIRED = (
+    'flat_tile_budget', 'amp', 'mesh',
+    'overlap', 'overlap_bucket_mb', 'pp_microbatches',
+)
+
 
 def _pristine_flags():
     """A fresh, private instance of paddle_tpu/flags.py — the audit
@@ -121,6 +130,13 @@ def check():
             errors.append("%s: empty subsystem" % where)
         if not (t.help or '').strip():
             errors.append("%s: empty help string" % where)
+    for name in _REQUIRED:
+        if name not in seen:
+            errors.append(
+                "required tunable %r is no longer registered — the "
+                "knob still works as a flag but the autotuner can no "
+                "longer search it; restore the register_tunable() "
+                "call in paddle_tpu/tuning/registry.py" % name)
     return errors
 
 
