@@ -191,6 +191,7 @@ def main():
     results.extend(fleet_scenario(tpu))
     results.extend(multitenant_scenario(tpu))
     results.extend(online_scenario(tpu))
+    results.extend(decode_scenario(tpu))
     # attach the observability snapshot so BENCH_*.json runs carry the
     # queue/occupancy/latency telemetry behind the headline numbers
     # (empty when PADDLE_TPU_METRICS_ENABLED=0 — servers then report to
@@ -1228,3 +1229,135 @@ def dynamic_scenario(tpu):
 
 if __name__ == '__main__':
     main()
+
+
+def decode_scenario(tpu):
+    """Autoregressive decode under open-loop Poisson traffic (ISSUE 19):
+    streams of MIXED prompt/generation lengths arrive at random times
+    against the paged-KV DecodeEngine, served two ways over the same
+    arrival schedule —
+
+      continuous: streams join mid-decode at step granularity the
+        moment a slot + pages free up (work-conserving), vs
+      static: generation-batch baseline — a new group is admitted only
+        when every slot drained (the barrier continuous batching
+        removes)
+
+    — reporting p50/p99 time-to-first-token, p50/p99 per-token latency,
+    and generated tokens/s via common.generated_tokens_per_sec (the
+    same accounting bench_decode.py's headline uses).  The bar: ZERO
+    dropped streams, ZERO post-warmup compiles, and continuous
+    throughput strictly above the static baseline at mixed lengths.
+    The continuous row also carries the on-chip roofline prediction
+    from cost_model.decode_step_cost — the modeled TPU tokens/s next
+    to the measured CPU-smoke number, per the PERF.md convention."""
+    import paddle_tpu as fluid
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeServer, \
+        extract_params
+    from paddle_tpu.models import transformer
+    from paddle_tpu.transpiler.cost_model import decode_step_cost
+    from common import generated_tokens_per_sec
+
+    if tpu:
+        L, D, H, V, T = 6, 512, 8, 30000, 512
+        page, streams, bucket = 16, 16, 256
+        n_req, mean_gap_s = 64, 0.001
+    else:
+        L, D, H, V, T = 2, 64, 4, 200, 64
+        page, streams, bucket = 8, 4, 32
+        n_req, mean_gap_s = 24, 0.001
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = 19
+        with fluid.program_guard(main_p, startup):
+            transformer.build(vocab_size=V, seq_len=T, n_layers=L,
+                              d_model=D, n_heads=H)
+        exe = fluid.Executor(fluid.TPUPlace(0) if tpu
+                             else fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        params = extract_params(scope, L)
+    eng = DecodeEngine(params, n_layers=L, n_heads=H, page_size=page,
+                       max_streams=streams, prefill_bucket=bucket)
+    eng.warmup()
+
+    # ONE arrival schedule + workload for both treatments: Poisson
+    # gaps, prompts mixed across the bucket ladder, mixed generation
+    # lengths — the shape continuous batching exists for
+    rng = np.random.default_rng(7)
+    gaps = rng.exponential(mean_gap_s, n_req)
+    plens = rng.choice([4, 7, 11, 15, 22, 30], n_req).astype(int)
+    if not tpu:
+        plens = np.minimum(plens, bucket - 2)
+    nnews = rng.choice([6, 10, 16, 24], n_req).astype(int)
+    prompts = [rng.integers(1, V, int(p)).astype(np.int64)
+               for p in plens]
+
+    results = []
+    throughput = {}
+    for label, static in (('continuous', False), ('static', True)):
+        srv = DecodeServer(eng, static_batching=static)
+        t_start = time.perf_counter()
+        streams_out = []
+        for gap, prompt, nn in zip(gaps, prompts, nnews):
+            time.sleep(float(gap))
+            streams_out.append(srv.submit(prompt,
+                                          max_new_tokens=int(nn)))
+        assert srv.drain(timeout=600.0), "decode drain timed out"
+        wall = time.perf_counter() - t_start
+        stats = srv.stats()
+        srv.close()
+        assert stats['dropped'] == 0, stats
+        assert stats['compiles_after_warmup'] == 0, stats
+        assert stats['completed'] == n_req, stats
+        ttfts = np.asarray([st.ttft_s for st in streams_out])
+        per_tok = np.concatenate([st.per_token_s()
+                                  for st in streams_out
+                                  if len(st.per_token_s())])
+        n_generated = int(sum(len(st.tokens) for st in streams_out))
+        thr = generated_tokens_per_sec(n_generated, wall)
+        throughput[label] = thr
+        r = {"metric": "decode_generated_tokens_per_sec",
+             "value": round(thr, 2),
+             "batching": label,
+             "streams": n_req,
+             "p50_ttft_ms": round(float(np.percentile(ttfts, 50))
+                                  * 1e3, 2),
+             "p99_ttft_ms": round(float(np.percentile(ttfts, 99))
+                                  * 1e3, 2),
+             "p50_tok_ms": round(float(np.percentile(per_tok, 50))
+                                 * 1e3, 2),
+             "p99_tok_ms": round(float(np.percentile(per_tok, 99))
+                                 * 1e3, 2),
+             "dropped": stats['dropped'],
+             "compiles_after_warmup": stats['compiles_after_warmup'],
+             "note": "L=%d D=%d V=%d page=%d slots=%d; mixed prompts "
+                     "%d-%d + mixed gen %d-%d, Poisson mean gap %.0fms"
+                     % (L, D, V, page, streams, plens.min(),
+                        plens.max(), nnews.min(), nnews.max(),
+                        mean_gap_s * 1e3)}
+        if not static:
+            # on-chip prediction: one full-width decode step priced by
+            # the closed-form model against the calibrated roofline —
+            # tokens/s = S / max(compute floor, bandwidth floor)
+            c = decode_step_cost(L, D, H, 4 * D, V, streams,
+                                 ctx_len=int(plens.mean()
+                                             + nnews.mean() // 2))
+            peak = float(os.environ.get('PADDLE_TPU_PEAK_TFLOPS')
+                         or 0) or 192.0
+            gbps = float(os.environ.get('PADDLE_TPU_HBM_GBPS')
+                         or 0) or 819.0
+            step_floor = max(c['flops'] / (peak * 1e12),
+                             c['bytes'] / (gbps * 1e9))
+            r['modeled_tpu_tokens_per_sec'] = round(
+                streams / step_floor, 1)
+            r['modeled_step_bound'] = (
+                'mxu' if c['flops'] / (peak * 1e12)
+                >= c['bytes'] / (gbps * 1e9) else 'hbm')
+        print(json.dumps(r))
+        results.append(r)
+    assert throughput['continuous'] > throughput['static'], (
+        "continuous batching must beat the generation-batch baseline: "
+        "%r" % throughput)
+    return results

@@ -1,0 +1,77 @@
+"""Decoder-only transformer LM (models/transformer.py, ISSUE 19) —
+train tokens/s with cost-model MFU on the synced-wall basis.
+
+Rows: an f32 baseline and the AMP bf16 lowering side by side
+(amp_compare), each carrying the --roofline MFU derived from the
+bench's own block_until_ready wall — the convention every MFU number
+in PERF.md uses.  ``--mesh`` switches to the SPMD scaling rows
+(one per PADDLE_TPU_MESH spec) over the same program.
+"""
+import argparse
+
+import numpy as np
+
+from common import (bench_cli, ensure_mesh_devices, mesh_bench, on_tpu,
+                    run_bench)
+
+
+def main(argv=None):
+    cli = bench_cli(argv)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--mesh', action='append', default=None,
+                    metavar='SPEC',
+                    help="multi-chip SPMD scaling run: one row per "
+                         "PADDLE_TPU_MESH spec (repeatable, e.g. "
+                         "--mesh off --mesh dp=2 --mesh fsdp=4); "
+                         "forces virtual host devices on CPU")
+    args, _ = ap.parse_known_args(argv)
+    if args.mesh:
+        # must precede the first jax import (device count freezes)
+        ensure_mesh_devices(args.mesh)
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    if on_tpu():
+        batch, seq, vocab = 32, 512, 30000
+        n_layers, d_model, n_heads = 6, 512, 8
+    else:
+        batch, seq, vocab = 4, 32, 200
+        n_layers, d_model, n_heads = 2, 64, 4
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            _src, _tgt, avg_cost = transformer.build(
+                vocab_size=vocab, seq_len=seq, n_layers=n_layers,
+                d_model=d_model, n_heads=n_heads)
+            fluid.optimizer.AdamOptimizer(
+                learning_rate=1e-3).minimize(avg_cost)
+        return main_p, startup, avg_cost
+
+    rng = np.random.default_rng(0)
+
+    def feed():
+        src = rng.integers(1, vocab, (batch, seq)).astype(np.int64)
+        tgt = np.roll(src, -1, axis=1)[..., None]
+        return {'src': src, 'target': tgt}
+
+    note = 'batch=%d seq=%d vocab=%d L=%d D=%d H=%d' % (
+        batch, seq, vocab, n_layers, d_model, n_heads)
+
+    if args.mesh:
+        mesh_bench('transformer_lm_mesh_scaling', batch * seq,
+                   build, feed, args.mesh, note=note)
+        return
+
+    # ONE call, TWO rows: amp=off is the f32 baseline, amp=bf16 runs
+    # the same build through the AMP pass (attention/matmuls WHITE).
+    # roofline=True attaches cost-model MFU at the measured synced
+    # step wall — the acceptance basis for the PERF.md round-19 rows.
+    run_bench('transformer_lm_tokens_per_sec', batch * seq, build,
+              feed, steps=50 if on_tpu() else 3, note=note,
+              amp_compare='bf16', tune=cli.tune, roofline=True)
+
+
+if __name__ == '__main__':
+    main()

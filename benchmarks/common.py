@@ -127,6 +127,18 @@ def _maybe_roofline(result, exe, unit_count):
     print(rl.format_report(rep), file=sys.stderr)
 
 
+def generated_tokens_per_sec(n_generated, wall_s):
+    """THE decode-throughput accounting, shared so every generation
+    bench reports the same metric the same way: GENERATED tokens (the
+    model's own emissions — prompt/source tokens excluded, beam
+    hypotheses not multiplied in) per second of synced wall.  Used by
+    bench_decode.py (batch x max_len per decode) and bench_serving.py's
+    decode scenario (sum of per-stream new tokens)."""
+    if wall_s <= 0:
+        raise ValueError("wall_s must be positive, got %r" % wall_s)
+    return float(n_generated) / float(wall_s)
+
+
 def maybe_force_cpu():
     """Honour a CPU-smoke request via the config API: the bench box's
     sitecustomize re-registers the TPU tunnel plugin and clears

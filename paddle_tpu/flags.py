@@ -453,6 +453,26 @@ DEFINE_int('serving_max_batch', 8,
            'not passed explicitly: buckets are powers of two up to '
            'this many rows.  A registered tunable the serving benches '
            'can search')
+DEFINE_int('decode_page_size', 16,
+           'positions per KV-cache page in the autoregressive decode '
+           'engine (inference/decode.py): each stream holds '
+           'ceil(context/page_size) pages of the device-resident '
+           '[num_pages, page_size, heads, head_dim] pools.  Smaller '
+           'pages waste less tail capacity on short streams; larger '
+           'ones shrink the page table and the gather fan-out.  A '
+           'registered tunable (tuning/registry.py)')
+DEFINE_int('decode_max_streams', 8,
+           'decode batch slots: how many streams one DecodeEngine '
+           'steps concurrently.  The continuous-batching server admits '
+           'a queued stream the moment a slot (and pages) free up, at '
+           'step granularity.  Fixed at engine build — it is the '
+           'compiled decode-step batch shape.  A registered tunable')
+DEFINE_int('decode_prefill_bucket', 128,
+           'top of the prefill bucket ladder (page-size multiples '
+           'doubling up to this): prompts pad to the next bucket so '
+           'only ~log2 distinct prefill shapes ever compile; prompts '
+           'longer than the top bucket are rejected at submit.  A '
+           'registered tunable')
 DEFINE_float('peak_tflops', 0.0,
              'device peak TFLOP/s for MFU and roofline accounting '
              '(bench.py, benchmarks/common.py, tuning/roofline.py): '

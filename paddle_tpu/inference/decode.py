@@ -1,0 +1,675 @@
+"""Autoregressive decode engine: continuous batching over a
+device-resident paged KV cache (ISSUE 19 tentpole).
+
+The serving stack's generation path used to be the O(T^2) one: re-run
+the full context for every emitted token.  This module is the standard
+inference-throughput fix for decoder-only LMs, TPU-native:
+
+- **prefill/decode split** — a prompt runs ONCE through a full-context
+  forward (per-bucket AOT-compiled, page-size-multiple bucket ladder so
+  only ~log2 prefill shapes ever compile), its per-layer K/V land in
+  claimed cache pages, and its last-position logits yield the first
+  token (the TTFT moment).  Every later token is one batched decode
+  step: embed S current tokens, append their K/V into the cache, and
+  attend over pages (ops/attention.py ``paged_attention``).
+- **paged KV cache** — per-layer page pools
+  ``[num_pages, page_size, heads, head_dim]`` resident in device memory
+  with a HOST-side page table and free list.  Streams claim
+  ceil(span/page_size) pages at admission and free them the step they
+  finish; a stream's pages need not be contiguous, so the pool packs
+  mixed-length streams without fragmentation-driven copies.  The pools
+  are **donated chunk→chunk** through every compiled prefill-pack and
+  decode step (``donate_argnums``) — the cache never round-trips to
+  host and never double-buffers.
+- **continuous batching** — admission happens at STEP granularity: a
+  queued stream joins the running batch the moment a slot and pages
+  free up, and a finished stream's slot is reusable the very next step.
+  Throughput is work-conserving instead of generation-batch-barriered;
+  ``static_batching=True`` on the server reproduces the barriered
+  baseline for the A/B the decode bench reports.
+
+Everything device-facing is AOT-compiled at ``warmup()`` via
+``jit(...).lower(...).compile()`` — the serving loop only ever calls
+precompiled executables, and ``stats()['compiles_after_warmup']``
+counts any miss instead of hiding a multi-second stall.
+"""
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import observability as _obs
+from ..analysis import lockdebug as _lkd
+from ..core.registry import get_op_impl
+from ..transpiler.memory_model import page_pool_bytes
+
+__all__ = ['DecodeEngine', 'DecodeServer', 'DecodeStream',
+           'extract_params', 'decode_buckets']
+
+_server_seq = itertools.count()
+
+
+def extract_params(scope, n_layers):
+    """Pull the transformer's fixed-name ``tr_*`` parameters out of a
+    scope (models/transformer.py param_names manifest) as a plain
+    {name: jax.Array} dict — the engine's weights."""
+    from ..models.transformer import param_names
+    return {n: jnp.asarray(scope.get(n)) for n in param_names(n_layers)}
+
+
+def decode_buckets(page_size, top):
+    """The prefill bucket ladder: page-size multiples doubling up to
+    ``top`` (inclusive) — [P, 2P, 4P, ...].  Prompts pad to the next
+    bucket so only ~log2 prefill shapes ever compile."""
+    page_size, top = int(page_size), int(top)
+    if top < page_size or top % page_size:
+        raise ValueError(
+            "prefill bucket top %d must be a multiple of page_size %d"
+            % (top, page_size))
+    sizes = [page_size]
+    while sizes[-1] < top:
+        sizes.append(min(sizes[-1] * 2, top))
+    return sizes
+
+
+def _ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return (xf - mean) / jnp.sqrt(var + eps) * w + b
+
+
+def _forward(params, tokens, n_layers, n_heads):
+    """Full-context forward over [B, T] int32 tokens: the prefill path
+    and the parity reference (same ops/attention.py dense math the
+    program's flash_attention op runs off-TPU).  Returns
+    (logits [B, T, V], k_all [L, B, T, H, Dh], v_all)."""
+    from ..ops.attention import _dense_attention
+    b, t = tokens.shape
+    x = params['tr_embed'][tokens] + params['tr_pos'][:t][None]
+    d = x.shape[-1]
+    dh = d // n_heads
+    ks, vs = [], []
+    for i in range(n_layers):
+        p = 'tr_l%d_' % i
+        h = _ln(x, params[p + 'ln_attn_w'], params[p + 'ln_attn_b'])
+        qkv = h @ params[p + 'qkv_w'] + params[p + 'qkv_b']
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, n_heads, dh)
+        k = k.reshape(b, t, n_heads, dh)
+        v = v.reshape(b, t, n_heads, dh)
+        ks.append(k)
+        vs.append(v)
+        ctx = _dense_attention(q, k, v, True, None).reshape(b, t, d)
+        x = x + ctx @ params[p + 'proj_w'] + params[p + 'proj_b']
+        h = _ln(x, params[p + 'ln_ffn_w'], params[p + 'ln_ffn_b'])
+        h = jnp.maximum(h @ params[p + 'ffn_up_w']
+                        + params[p + 'ffn_up_b'], 0.0)
+        x = x + h @ params[p + 'ffn_down_w'] + params[p + 'ffn_down_b']
+    x = _ln(x, params['tr_ln_f_w'], params['tr_ln_f_b'])
+    logits = x @ params['tr_head_w'] + params['tr_head_b']
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+class PagedKVCache(object):
+    """Device page pools + host free list.  The pools are plain jax
+    arrays the engine threads through its donated compiled calls; the
+    free list / page tables are host state (the server's worker thread
+    owns them — no lock needed beyond the server's own)."""
+
+    def __init__(self, n_layers, num_pages, page_size, n_heads,
+                 head_dim, dtype=jnp.float32):
+        self.n_layers = int(n_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        # one extra TRASH page (index num_pages): padded page-table
+        # entries and inactive slots direct their writes there, so the
+        # compiled step needs no masking on the scatter
+        self.trash = self.num_pages
+        shape = (self.n_layers, self.num_pages + 1, self.page_size,
+                 self.n_heads, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._free = list(range(self.num_pages))
+
+    def free_pages(self):
+        return len(self._free)
+
+    def alloc(self, n):
+        """Claim ``n`` pages or None when the pool can't supply them —
+        the caller (admission) keeps the stream queued, never drops."""
+        if n > len(self._free):
+            return None
+        pages, self._free = self._free[:n], self._free[n:]
+        return pages
+
+    def free(self, pages):
+        self._free.extend(pages)
+
+    def resident_bytes(self):
+        """Golden closed form: layers x {K,V} x pages x page_size x
+        heads x head_dim x dtype (trash page included — it is
+        resident)."""
+        return page_pool_bytes(self.num_pages + 1, self.page_size,
+                               self.n_heads, self.head_dim,
+                               self.k.dtype, n_layers=self.n_layers)
+
+
+class DecodeEngine(object):
+    """Compiled prefill/pack/decode executables over one weight set.
+
+    Not thread-safe by design: exactly one caller (the DecodeServer
+    worker) drives it, and the page pools move through donated
+    arguments — concurrent calls would use donated buffers.
+    """
+
+    def __init__(self, params, n_layers, n_heads, page_size=None,
+                 num_pages=None, max_streams=None, prefill_bucket=None,
+                 dtype=jnp.float32):
+        from ..flags import FLAGS
+        self.params = {n: jnp.asarray(v) for n, v in params.items()}
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.d_model = int(self.params['tr_embed'].shape[1])
+        self.head_dim = self.d_model // self.n_heads
+        self.vocab_size = int(self.params['tr_embed'].shape[0])
+        self.max_seq = int(self.params['tr_pos'].shape[0])
+        self.page_size = int(page_size or FLAGS.decode_page_size)
+        self.max_streams = int(max_streams or FLAGS.decode_max_streams)
+        if self.max_seq % self.page_size:
+            raise ValueError("max_seq %d not a page_size %d multiple"
+                             % (self.max_seq, self.page_size))
+        self.pages_per_stream = self.max_seq // self.page_size
+        if num_pages is None:
+            num_pages = self.max_streams * self.pages_per_stream
+        top = int(prefill_bucket or FLAGS.decode_prefill_bucket)
+        self.buckets = decode_buckets(self.page_size,
+                                      min(top, self.max_seq))
+        self.cache = PagedKVCache(self.n_layers, num_pages,
+                                  self.page_size, self.n_heads,
+                                  self.head_dim, dtype)
+        self.compiles_total = 0
+        self._compiles_at_warmup = None
+        self._prefill = {}   # bucket -> compiled (params, tokens)
+        self._pack = {}      # bucket -> compiled (k, v, pools, pages)
+        self._step = None
+
+    # -- compiled function builders ------------------------------------
+
+    def _compile(self, fn, *args, donate=()):
+        compiled = jax.jit(fn, donate_argnums=donate).lower(
+            *args).compile()
+        self.compiles_total += 1
+        return compiled
+
+    def _ensure_prefill(self, bucket):
+        if bucket in self._prefill:
+            return
+        L, H, Dh, P = (self.n_layers, self.n_heads, self.head_dim,
+                       self.page_size)
+        n_pages = bucket // P
+
+        def prefill(params, tokens, last):
+            # ``last`` (the prompt's final position) is a traced
+            # operand, NOT python int: slicing the returned logits on
+            # the host would dispatch an op-by-op gather whose hidden
+            # per-shape compile (~25-40ms) lands on the first stream
+            # of every bucket — invisible to compiles_total
+            logits, k, v = _forward(params, tokens[None], L, H)
+            return logits[0, last], k[:, 0], v[:, 0]
+
+        def pack(k_pool, v_pool, k, v, pages):
+            # scatter the prefill K/V into the claimed pages: [L, T, H,
+            # Dh] -> [L, n_pages, P, H, Dh] written at ``pages`` (padded
+            # entries point at the trash page)
+            kp = k.reshape(L, n_pages, P, H, Dh)
+            vp = v.reshape(L, n_pages, P, H, Dh)
+            k_pool = k_pool.at[:, pages].set(kp)
+            v_pool = v_pool.at[:, pages].set(vp)
+            return k_pool, v_pool
+
+        toks = jnp.zeros((bucket,), jnp.int32)
+        self._prefill[bucket] = self._compile(prefill, self.params,
+                                              toks, jnp.int32(0))
+        kv = jnp.zeros((L, bucket, H, Dh), self.cache.k.dtype)
+        pages = jnp.zeros((n_pages,), jnp.int32)
+        self._pack[bucket] = self._compile(
+            pack, self.cache.k, self.cache.v, kv, kv, pages,
+            donate=(0, 1))
+
+    def _ensure_step(self):
+        if self._step is not None:
+            return
+        L, H, Dh, D = (self.n_layers, self.n_heads, self.head_dim,
+                       self.d_model)
+        P, S = self.page_size, self.max_streams
+        mpp = self.pages_per_stream
+        params = self.params
+        paged = get_op_impl('paged_attention').compute
+
+        def step(k_pool, v_pool, tokens, pt, ctx_len):
+            # ctx_len counts CACHED positions per slot; the incoming
+            # token sits at position ctx_len and is cached this step.
+            pos = jnp.clip(ctx_len, 0, self.max_seq - 1)
+            x = params['tr_embed'][tokens] + params['tr_pos'][pos]
+            page_idx = jnp.take_along_axis(
+                pt, (pos // P)[:, None], axis=1)[:, 0]
+            offset = pos % P
+            for i in range(L):
+                p = 'tr_l%d_' % i
+                h = _ln(x, params[p + 'ln_attn_w'],
+                        params[p + 'ln_attn_b'])
+                qkv = h @ params[p + 'qkv_w'] + params[p + 'qkv_b']
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(S, H, Dh)
+                k = k.reshape(S, H, Dh).astype(k_pool.dtype)
+                v = v.reshape(S, H, Dh).astype(v_pool.dtype)
+                k_pool = k_pool.at[i, page_idx, offset].set(k)
+                v_pool = v_pool.at[i, page_idx, offset].set(v)
+                ctx = paged(None, {'Q': [q], 'KPool': [k_pool[i]],
+                                   'VPool': [v_pool[i]], 'PT': [pt],
+                                   'CtxLen': [pos + 1]},
+                            {})['Out'][0]
+                x = x + ctx.reshape(S, D) @ params[p + 'proj_w'] \
+                    + params[p + 'proj_b']
+                h = _ln(x, params[p + 'ln_ffn_w'],
+                        params[p + 'ln_ffn_b'])
+                h = jnp.maximum(h @ params[p + 'ffn_up_w']
+                                + params[p + 'ffn_up_b'], 0.0)
+                x = x + h @ params[p + 'ffn_down_w'] \
+                    + params[p + 'ffn_down_b']
+            x = _ln(x, params['tr_ln_f_w'], params['tr_ln_f_b'])
+            logits = x @ params['tr_head_w'] + params['tr_head_b']
+            return k_pool, v_pool, logits, jnp.argmax(logits, axis=-1)
+
+        self._step = self._compile(
+            step, self.cache.k, self.cache.v,
+            jnp.zeros((S,), jnp.int32),
+            jnp.full((S, mpp), self.cache.trash, jnp.int32),
+            jnp.zeros((S,), jnp.int32), donate=(0, 1))
+
+    def warmup(self):
+        """AOT-compile every prefill bucket, its pack, and the decode
+        step, then EXECUTE each once: the first invocation of a fresh
+        executable pays one-time runtime setup (buffer finalization —
+        measured 25-85ms per executable on the CPU backend) that must
+        never land on a live stream's latency.  The dummy executions
+        route every write to the trash page, so pool contents survive
+        bit-for-bit even on a re-warm with streams resident.
+        Afterwards the serving loop calls only precompiled, pre-run
+        executables (compiles_after_warmup counts any miss)."""
+        if self._compiles_at_warmup == self.compiles_total:
+            return  # already compiled AND warm-executed, nothing new
+        for b in self.buckets:
+            self._ensure_prefill(b)
+        self._ensure_step()
+        trash = self.cache.trash
+        for b in self.buckets:
+            logits, k, v = self._prefill[b](
+                self.params, jnp.zeros((b,), jnp.int32),
+                jnp.int32(0))
+            all_trash = jnp.full((b // self.page_size,), trash,
+                                 jnp.int32)
+            self.cache.k, self.cache.v = self._pack[b](
+                self.cache.k, self.cache.v, k, v, all_trash)
+            jax.block_until_ready(logits)
+        S, mpp = self.max_streams, self.pages_per_stream
+        self.cache.k, self.cache.v, logits, _ = self._step(
+            self.cache.k, self.cache.v, jnp.zeros((S,), jnp.int32),
+            jnp.full((S, mpp), trash, jnp.int32),
+            jnp.zeros((S,), jnp.int32))
+        jax.block_until_ready(logits)
+        self._compiles_at_warmup = self.compiles_total
+
+    @property
+    def compiles_after_warmup(self):
+        if self._compiles_at_warmup is None:
+            return self.compiles_total
+        return self.compiles_total - self._compiles_at_warmup
+
+    # -- serving-loop entry points -------------------------------------
+
+    def bucket_for(self, prompt_len):
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError("prompt length %d exceeds top prefill bucket "
+                         "%d" % (prompt_len, self.buckets[-1]))
+
+    def prefill_into(self, prompt, pages):
+        """Run one prompt's prefill and pack its K/V into ``pages``
+        (the stream's claimed pages, page 0 of the stream first).
+        Returns the last-position logits as numpy [V] — the first
+        generated token's distribution, i.e. the TTFT payload."""
+        prompt = np.asarray(prompt, dtype=np.int32)
+        t = int(prompt.shape[0])
+        bucket = self.bucket_for(t)
+        self._ensure_prefill(bucket)
+        toks = np.zeros((bucket,), np.int32)
+        toks[:t] = prompt
+        logits, k, v = self._prefill[bucket](
+            self.params, jnp.asarray(toks), jnp.int32(t - 1))
+        n_pages = bucket // self.page_size
+        page_ids = np.full((n_pages,), self.cache.trash, np.int32)
+        n_real = min(len(pages), n_pages)
+        page_ids[:n_real] = pages[:n_real]
+        self.cache.k, self.cache.v = self._pack[bucket](
+            self.cache.k, self.cache.v, k, v, jnp.asarray(page_ids))
+        return np.asarray(logits)
+
+    def step(self, tokens, page_tables, ctx_lens):
+        """One batched decode step over all ``max_streams`` slots.
+        Inactive slots pass token 0 with an all-trash page-table row —
+        their writes land in the trash page and their outputs are
+        ignored.  Returns (next_tokens [S], logits [S, V]) numpy."""
+        self._ensure_step()
+        self.cache.k, self.cache.v, logits, nxt = self._step(
+            self.cache.k, self.cache.v,
+            jnp.asarray(tokens, dtype=jnp.int32),
+            jnp.asarray(page_tables, dtype=jnp.int32),
+            jnp.asarray(ctx_lens, dtype=jnp.int32))
+        return np.asarray(nxt), np.asarray(logits)
+
+    def resident_bytes(self):
+        return self.cache.resident_bytes()
+
+
+class _DecodeMetrics(object):
+    """Per-server decode metrics, labeled ``server="d<N>"`` (the
+    _ServingMetrics pattern: global registry when observability is
+    enabled, else a private one so stats() keeps working)."""
+
+    def __init__(self, reg, sid):
+        L = ('server',)
+        self._sid = sid
+        self._families = []
+
+        def child(metric):
+            self._families.append(metric)
+            return metric.labels(server=sid)
+
+        self.streams_active = child(reg.gauge(
+            'paddle_tpu_decode_streams_active',
+            'streams currently holding a decode batch slot', L))
+        self.queue_depth = child(reg.gauge(
+            'paddle_tpu_decode_queue_depth',
+            'streams waiting for a slot or pages', L))
+        self.ttft = child(reg.histogram(
+            'paddle_tpu_decode_ttft_seconds',
+            'submit-to-first-token latency per stream (prefill path)',
+            L, buckets=_obs.DEFAULT_LATENCY_BUCKETS))
+        self.pages_allocated = child(reg.counter(
+            'paddle_tpu_decode_pages_allocated_total',
+            'KV-cache pages claimed at stream admission', L))
+        self.pages_freed = child(reg.counter(
+            'paddle_tpu_decode_pages_freed_total',
+            'KV-cache pages returned by finished streams', L))
+        self.tokens = child(reg.counter(
+            'paddle_tpu_decode_tokens_generated_total',
+            'tokens emitted across all streams (prefill + decode)', L))
+        self.steps = child(reg.counter(
+            'paddle_tpu_decode_steps_total',
+            'batched decode steps executed', L))
+
+    def close(self):
+        for m in self._families:
+            m.remove(server=self._sid)
+
+
+class DecodeStream(object):
+    """Submit handle: resolves to the generated token ids."""
+
+    def __init__(self, rid, prompt, max_new_tokens):
+        self.request_id = rid
+        self.prompt = np.asarray(prompt, dtype=np.int32)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens = []          # generated ids, worker-appended
+        self.token_times = []     # perf_counter per emitted token
+        self.submitted_t = time.perf_counter()
+        self.first_token_t = None
+        self.done_t = None
+        self.error = None
+        self._done = threading.Event()
+        # worker-side state
+        self._slot = None
+        self._pages = None
+        self._ctx_len = 0         # cached positions
+
+    @property
+    def ttft_s(self):
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submitted_t
+
+    def per_token_s(self):
+        """Inter-token gaps (decode-step latency as a client sees it)."""
+        ts = self.token_times
+        return [b - a for a, b in zip(ts, ts[1:])]
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("stream %s still decoding"
+                               % self.request_id)
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+
+class DecodeServer(object):
+    """Continuous-batching decode worker over one DecodeEngine.
+
+    ``submit`` queues a prompt; the worker admits it the moment a batch
+    slot and enough cache pages free up (claiming
+    ceil((prompt+max_new)/page_size) pages so a stream never stalls
+    mid-decode), runs its prefill, and folds it into the running
+    batched decode step.  Finished streams free their pages and slot
+    immediately — the next step can admit a queued stream into them.
+
+    ``static_batching=True`` is the baseline for the A/B: admission
+    waits until the WHOLE batch finished, i.e. generation-batch
+    barriers (every stream in a generation must finish before any new
+    one starts).
+    """
+
+    def __init__(self, engine, static_batching=False, greedy=True,
+                 warmup=True):
+        self.engine = engine
+        self.static = bool(static_batching)
+        self.greedy = bool(greedy)
+        lock = threading.Lock()
+        # one lock, one wait-set: submit/close wake the worker
+        self._cv = _lkd.make_condition('DecodeServer._cv', lock)
+        self._queue = deque()     # guarded by _cv
+        self._slots = [None] * engine.max_streams  # worker-owned
+        self._stopping = False    # guarded by _cv
+        self._submitted = 0
+        self._completed = 0
+        sid = 'd%d' % next(_server_seq)
+        reg = _obs.registry() if _obs.enabled() \
+            else _obs.MetricsRegistry()
+        self._m = _DecodeMetrics(reg, sid)
+        if _obs.enabled():
+            _obs.maybe_serve_from_env()
+        if warmup:
+            engine.warmup()
+        self._worker = threading.Thread(target=self._loop,
+                                        name='decode-worker-%s' % sid,
+                                        daemon=True)
+        self._worker.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=16, request_id=None):
+        prompt = np.asarray(prompt, dtype=np.int32)
+        span = int(prompt.shape[0]) + int(max_new_tokens)
+        if span > self.engine.max_seq:
+            raise ValueError("prompt+max_new %d exceeds max_seq %d"
+                             % (span, self.engine.max_seq))
+        self.engine.bucket_for(len(prompt))  # reject oversize early
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("DecodeServer is closed")
+            rid = request_id if request_id is not None \
+                else 'r%d' % self._submitted
+            st = DecodeStream(rid, prompt, max_new_tokens)
+            self._queue.append(st)
+            self._submitted += 1
+            self._m.queue_depth.set(len(self._queue))
+            self._cv.notify()
+        return st
+
+    def drain(self, timeout=60.0):
+        """Block until every submitted stream finished."""
+        deadline = time.perf_counter() + timeout
+        with self._cv:
+            while self._queue or any(s is not None
+                                     for s in self._slots):
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.1))
+        return True
+
+    def close(self):
+        with self._cv:
+            if self._stopping:
+                return
+            self._stopping = True
+            self._cv.notify_all()
+        self._worker.join(timeout=30.0)
+        self._m.close()
+
+    def stats(self):
+        with self._cv:
+            active = sum(1 for s in self._slots if s is not None)
+            return {
+                'submitted': self._submitted,
+                'completed': self._completed,
+                'dropped': 0,  # admission queues, never sheds
+                'active_streams': active,
+                'queued': len(self._queue),
+                'free_pages': self.engine.cache.free_pages(),
+                'generated_tokens': int(self._m.tokens.value),
+                'decode_steps': int(self._m.steps.value),
+                'compiles_total': self.engine.compiles_total,
+                'compiles_after_warmup':
+                    self.engine.compiles_after_warmup,
+                'resident_bytes': self.engine.resident_bytes(),
+                'static_batching': self.static,
+            }
+
+    # -- worker side ---------------------------------------------------
+
+    def _pages_needed(self, st):
+        # the stream's whole span, claimed at admission so decode never
+        # stalls on a mid-stream page fault (prefill's bucket padding
+        # needs no extra pages — pack routes pad pages to trash)
+        span = len(st.prompt) + st.max_new_tokens
+        return -(-span // self.engine.page_size)
+
+    def _admit(self, st):
+        """Page claim + prefill for a slot-reserved stream.  Runs on
+        the worker OUTSIDE the lock (device work); the slot itself was
+        reserved under ``_cv`` by the loop."""
+        eng = self.engine
+        pages = eng.cache.alloc(self._pages_needed(st))
+        if pages is None:
+            return False
+        st._pages = pages
+        self._m.pages_allocated.inc(len(pages))
+        logits = eng.prefill_into(st.prompt, pages)
+        first = int(np.argmax(logits))
+        now = time.perf_counter()
+        st.first_token_t = now
+        st.tokens.append(first)
+        st.token_times.append(now)
+        st._ctx_len = len(st.prompt)
+        self._m.ttft.observe(st.ttft_s)
+        self._m.tokens.inc()
+        return True
+
+    def _retire(self, st):
+        self._slots[st._slot] = None
+        self.engine.cache.free(st._pages)
+        self._m.pages_freed.inc(len(st._pages))
+        st._pages = None
+        st.done_t = time.perf_counter()
+        self._completed += 1
+        st._done.set()
+
+    def _loop(self):
+        eng = self.engine
+        S, mpp = eng.max_streams, eng.pages_per_stream
+        trash = eng.cache.trash
+        while True:
+            with self._cv:
+                while not self._stopping and not self._queue and \
+                        all(s is None for s in self._slots):
+                    self._cv.wait(0.5)
+                if self._stopping and not self._queue and \
+                        all(s is None for s in self._slots):
+                    return
+                # admission at step granularity: continuous mode fills
+                # any free slot; static mode only starts a fresh
+                # generation once the whole previous batch retired
+                admissible = []
+                if not self.static or \
+                        all(s is None for s in self._slots):
+                    admissible = [i for i, s in enumerate(self._slots)
+                                  if s is None]
+                pending = []
+                while self._queue and admissible:
+                    st = self._queue.popleft()
+                    slot = admissible.pop(0)
+                    # reserve the slot under the lock so drain() never
+                    # sees the stream in neither queue nor slots
+                    st._slot = slot
+                    self._slots[slot] = st
+                    pending.append(st)
+                self._m.queue_depth.set(len(self._queue))
+            requeue = [st for st in pending if not self._admit(st)]
+            with self._cv:
+                for st in requeue:
+                    self._slots[st._slot] = None
+                    st._slot = None
+                if requeue:
+                    self._queue.extendleft(reversed(requeue))
+                    self._m.queue_depth.set(len(self._queue))
+                active = [s for s in self._slots if s is not None]
+                self._m.streams_active.set(len(active))
+            if not active:
+                continue
+            # build the batched step inputs from host stream state
+            tokens = np.zeros((S,), np.int32)
+            pts = np.full((S, mpp), trash, np.int32)
+            ctx = np.zeros((S,), np.int32)
+            for st in active:
+                i = st._slot
+                tokens[i] = st.tokens[-1]
+                pts[i, :len(st._pages)] = st._pages
+                ctx[i] = st._ctx_len
+            nxt, logits = eng.step(tokens, pts, ctx)
+            now = time.perf_counter()
+            self._m.steps.inc()
+            finished = []
+            for st in active:
+                i = st._slot
+                st._ctx_len += 1
+                if len(st.tokens) < st.max_new_tokens:
+                    st.tokens.append(int(nxt[i]))
+                    st.token_times.append(now)
+                    self._m.tokens.inc()
+                if len(st.tokens) >= st.max_new_tokens:
+                    finished.append(st)
+            with self._cv:
+                for st in finished:
+                    self._retire(st)
+                if finished:
+                    self._cv.notify_all()

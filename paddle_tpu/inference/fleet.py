@@ -150,6 +150,17 @@ RETIRED = 'retired'        # closed; kept only in stats history
 _STATES = (READY, UNROUTABLE, DRAINING)
 
 
+def _decode_resident(server):
+    """Modeled device residency of an attached decode server: the
+    paged KV pools plus the weight set.  Both live for the server's
+    whole lifetime — unlike a batching replica's compiled buckets,
+    nothing here is evictable, so the whole figure counts against the
+    fleet's HBM budget."""
+    eng = server.engine
+    return int(eng.resident_bytes()) + sum(
+        int(v.nbytes) for v in eng.params.values())
+
+
 def _run_backgrounded(fn):
     """Run ``fn`` on a throwaway thread at the lowest OS scheduling
     priority (per-thread nice 19 on Linux) and return its result,
@@ -525,6 +536,7 @@ class ServingFleet(object):
         self._probe_timeout = max(5.0, self._health_interval * 4)
 
         self._groups = {}        # tenant name -> _TenantGroup (_lock)
+        self._decode = {}        # tenant name -> DecodeServer (_lock)
         self._tenancy = _tn.TenantRegistry()
         # deferred-queue drain flags: the done-callback chain must not
         # recurse (drain -> dispatch -> instant failure -> callback ->
@@ -1170,6 +1182,57 @@ class ServingFleet(object):
                 out.append(d)
         return out
 
+    # -- decode attachment ---------------------------------------------
+    def attach_decode(self, server, tenant=None):
+        """Host a :class:`~paddle_tpu.inference.decode.DecodeServer`
+        under ``tenant``, sharing the fleet's HBM budget: the engine's
+        paged KV pools plus its weight set join the fleet residency
+        aggregate (and the watermark), so a later ``deploy()``'s
+        admission check sees them.  Under ``hbm_admission='enforce'``
+        an attach whose projected residency exceeds the budget raises
+        :class:`~paddle_tpu.inference.tenancy.AdmissionError` and
+        attaches nothing — the engine already allocated its pools (at
+        construction), so the caller must drop it; the rejection keeps
+        the fleet's accounting and subsequent deploys honest.  Decode
+        servers are not replicated or LRU-evicted: a KV pool serving
+        in-flight streams is not reclaimable the way a cold compiled
+        bucket is.  They ride the fleet for routing (``generate``),
+        residency accounting, ``stats()``, and ``close()``."""
+        tname = tenant if tenant is not None else _tn.DEFAULT_TENANT
+        need = _decode_resident(server)
+        live = self._resident_total()
+        if (self._hbm_budget
+                and self._admission_mode == 'enforce'
+                and live + need > self._hbm_budget):
+            self._m.admission_rejections.inc()
+            raise _tn.AdmissionError(tname, 'decode', self._hbm_budget,
+                                     live, need)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "ServingFleet %s is closed" % self._fid)
+            if tname in self._decode:
+                raise ValueError(
+                    "tenant %r already has a decode server attached"
+                    % tname)
+            self._decode[tname] = server
+        self._note_resident_watermark()
+        return server
+
+    def generate(self, prompt, max_new_tokens=16, tenant=None):
+        """Submit an autoregressive generation to ``tenant``'s attached
+        decode server; returns the ``DecodeStream`` handle (call
+        ``.result()`` for the generated tokens)."""
+        tname = tenant if tenant is not None else _tn.DEFAULT_TENANT
+        with self._lock:
+            srv = self._decode.get(tname)
+        if srv is None:
+            raise ValueError(
+                "tenant %r has no decode server; attach one with "
+                "fleet.attach_decode(DecodeServer(engine), tenant=%r)"
+                % (tname, tname))
+        return srv.submit(prompt, max_new_tokens=max_new_tokens)
+
     # -- resident-bytes accounting -------------------------------------
     def _resident_total(self, extra=()):
         """Modeled resident bytes across live replicas (READY /
@@ -1181,6 +1244,7 @@ class ServingFleet(object):
         with self._lock:
             reps = [r for g in self._groups.values()
                     for r in g.replicas if r.state in _STATES]
+            dec = list(self._decode.values())
         seen = set()
         total = 0
         for r in list(reps) + list(extra):
@@ -1190,6 +1254,7 @@ class ServingFleet(object):
                 continue
             seen.add(key)
             total += res.get('total_bytes', 0)
+        total += sum(_decode_resident(s) for s in dec)
         return total
 
     def _note_resident_watermark(self, extra=()):
@@ -1373,6 +1438,7 @@ class ServingFleet(object):
             by_reason = dict(self._rollbacks_by_reason)
             last_reason = self._last_deploy_reason
             watermark = self._resident_watermark
+            dec = dict(self._decode)
         version = self.version
         per = []
         for r in reps:
@@ -1431,6 +1497,7 @@ class ServingFleet(object):
             'quota_pending': self._tenancy.pending_total(),
             'quota_deferred': sum(t['deferred']
                                   for t in tenants.values()),
+            'decode': {name: s.stats() for name, s in dec.items()},
         }
 
     # -- shutdown ------------------------------------------------------
@@ -1455,11 +1522,15 @@ class ServingFleet(object):
             reps = self._reps_locked()
             for g in self._groups.values():
                 g.replicas = []
+            dec = list(self._decode.values())
+            self._decode = {}
         if self._health_thread is not None:
             self._stop.set()
             self._health_thread.join(
                 max(1.0, self._health_interval * 4))
         self._retire(reps)
+        for s in dec:
+            s.close()
         for nm, (feed, fut, rid) in self._tenancy.drain_all():
             if not fut.done():
                 fut.set_exception(RuntimeError(

@@ -1,0 +1,135 @@
+"""Decoder-only transformer LM (the GPT-style flagship, ISSUE 19).
+
+Pre-norm blocks composed from existing layers: flash_attention with a
+causal mask (nets.scaled_dot_product_attention), the FFN via fc (mul
+matmuls with fp32 master weights), and the fused vocab-projection +
+softmax-CE head (ops/chunked_ce.py) so the [N, V] logits never
+materialize in HBM.  Train-ready under the AMP pass and PADDLE_TPU_MESH
+— everything MXU-shaped is AMP_WHITE, and the dp/fsdp/tp SpecLayout
+specs from PR 12 were written for exactly these qkv/attn-out/ffn
+projections.
+
+Every parameter carries a FIXED name (``tr_*``) so an inference build
+(``build_logits``) and the autoregressive decode engine
+(inference/decode.py) reuse the trained weights: the engine pulls the
+``tr_*`` tensors straight out of the scope by name and runs the same
+math against its paged KV cache.
+"""
+import paddle_tpu as fluid
+
+__all__ = ['build', 'build_logits', 'param_names']
+
+
+def _attr(name):
+    from paddle_tpu.param_attr import ParamAttr
+    return ParamAttr(name=name)
+
+
+def _block(x, i, d_model, n_heads, d_ff):
+    """One pre-norm decoder block: x + attn(ln(x)), then x + ffn(ln(x))."""
+    layers = fluid.layers
+    # -- causal self-attention ------------------------------------------
+    ln1 = layers.layer_norm(
+        input=x, begin_norm_axis=2,
+        param_attr=_attr('tr_l%d_ln_attn_w' % i),
+        bias_attr=_attr('tr_l%d_ln_attn_b' % i))
+    qkv = layers.fc(input=ln1, size=3 * d_model, num_flatten_dims=2,
+                    param_attr=_attr('tr_l%d_qkv_w' % i),
+                    bias_attr=_attr('tr_l%d_qkv_b' % i))
+    q, k, v = layers.split(qkv, num_or_sections=3, dim=-1)
+    ctx = fluid.nets.scaled_dot_product_attention(
+        q, k, v, num_heads=n_heads, causal=True)
+    proj = layers.fc(input=ctx, size=d_model, num_flatten_dims=2,
+                     param_attr=_attr('tr_l%d_proj_w' % i),
+                     bias_attr=_attr('tr_l%d_proj_b' % i))
+    x = layers.elementwise_add(x=x, y=proj)
+    # -- position-wise FFN ----------------------------------------------
+    ln2 = layers.layer_norm(
+        input=x, begin_norm_axis=2,
+        param_attr=_attr('tr_l%d_ln_ffn_w' % i),
+        bias_attr=_attr('tr_l%d_ln_ffn_b' % i))
+    h = layers.fc(input=ln2, size=d_ff, num_flatten_dims=2, act='relu',
+                  param_attr=_attr('tr_l%d_ffn_up_w' % i),
+                  bias_attr=_attr('tr_l%d_ffn_up_b' % i))
+    h = layers.fc(input=h, size=d_model, num_flatten_dims=2,
+                  param_attr=_attr('tr_l%d_ffn_down_w' % i),
+                  bias_attr=_attr('tr_l%d_ffn_down_b' % i))
+    return layers.elementwise_add(x=x, y=h)
+
+
+def _trunk(src, vocab_size, seq_len, n_layers, d_model, n_heads, d_ff,
+           dtype):
+    layers = fluid.layers
+    emb = layers.embedding(input=src, size=[vocab_size, d_model],
+                           param_attr=_attr('tr_embed'))
+    # learned positional table [T, D]; broadcasts over the batch dim
+    pos = layers.create_parameter(shape=[seq_len, d_model],
+                                  dtype='float32', attr=_attr('tr_pos'))
+    x = layers.elementwise_add(x=emb, y=pos)
+    if dtype in ('bfloat16', 'float16'):
+        x = layers.cast(x=x, dtype=dtype)
+    for i in range(n_layers):
+        x = _block(x, i, d_model, n_heads, d_ff)
+    return layers.layer_norm(input=x, begin_norm_axis=2,
+                             param_attr=_attr('tr_ln_f_w'),
+                             bias_attr=_attr('tr_ln_f_b'))
+
+
+def build(vocab_size, seq_len=128, n_layers=2, d_model=128, n_heads=4,
+          d_ff=None, dtype='float32'):
+    """Train graph: returns (src, target, avg_cost).
+
+    src is a dense [B, T] int64 token grid (next-token prediction over
+    fixed-length windows — the packed-LM convention, no ragged LoD);
+    target is src shifted by one, fed as [B, T, 1].  The vocab head is
+    the fused projection+CE op; its ``tr_head_*`` params are reused by
+    ``build_logits`` and the decode engine."""
+    if d_ff is None:
+        d_ff = 4 * d_model
+    if d_model % n_heads:
+        raise ValueError("d_model %d not divisible by n_heads %d"
+                         % (d_model, n_heads))
+    layers = fluid.layers
+    src = layers.data(name='src', shape=[seq_len], dtype='int64')
+    target = layers.data(name='target', shape=[seq_len, 1],
+                         dtype='int64')
+    x = _trunk(src, vocab_size, seq_len, n_layers, d_model, n_heads,
+               d_ff, dtype)
+    cost = layers.fused_linear_softmax_ce(
+        input=x, label=target, size=vocab_size, num_flatten_dims=2,
+        param_attr=_attr('tr_head_w'), bias_attr=_attr('tr_head_b'))
+    avg_cost = layers.mean(x=cost)
+    return src, target, avg_cost
+
+
+def build_logits(vocab_size, seq_len=128, n_layers=2, d_model=128,
+                 n_heads=4, d_ff=None, dtype='float32'):
+    """Inference graph sharing every ``tr_*`` param with ``build``:
+    returns (src, logits) with logits [B, T, V] — the full-context
+    forward the decode engine's paged path is pinned against
+    (tests/test_decode.py)."""
+    if d_ff is None:
+        d_ff = 4 * d_model
+    layers = fluid.layers
+    src = layers.data(name='src', shape=[seq_len], dtype='int64')
+    x = _trunk(src, vocab_size, seq_len, n_layers, d_model, n_heads,
+               d_ff, dtype)
+    logits = layers.fc(input=x, size=vocab_size, num_flatten_dims=2,
+                       param_attr=_attr('tr_head_w'),
+                       bias_attr=_attr('tr_head_b'))
+    if dtype in ('bfloat16', 'float16'):
+        logits = layers.cast(x=logits, dtype='float32')
+    return src, logits
+
+
+def param_names(n_layers):
+    """Every fixed parameter name ``build`` creates, in layer order —
+    the extraction manifest the decode engine loads from a scope."""
+    names = ['tr_embed', 'tr_pos']
+    per_layer = ('ln_attn_w', 'ln_attn_b', 'qkv_w', 'qkv_b', 'proj_w',
+                 'proj_b', 'ln_ffn_w', 'ln_ffn_b', 'ffn_up_w',
+                 'ffn_up_b', 'ffn_down_w', 'ffn_down_b')
+    for i in range(n_layers):
+        names.extend('tr_l%d_%s' % (i, s) for s in per_layer)
+    names.extend(['tr_ln_f_w', 'tr_ln_f_b', 'tr_head_w', 'tr_head_b'])
+    return names

@@ -32,6 +32,50 @@ def _dense_attention(q, k, v, causal, scale):
     return o[:, :, 0, :] if squeeze else o
 
 
+def paged_attention_math(q, k_pool, v_pool, page_table, ctx_len,
+                         scale=None):
+    """Decode-step attention against a paged KV cache, the jnp math the
+    registered op and the decode engine share.
+
+    ``q`` [S, H, D] — one new token per stream slot; ``k_pool``/
+    ``v_pool`` [N, P, H, D] page pools; ``page_table`` [S, MPP] int32
+    page ids per stream (unused entries may point anywhere — typically
+    the trash page — their keys are masked); ``ctx_len`` [S] int32
+    VALID key count per stream, current token included.  Returns
+    [S, H, D].  Gathers each stream's pages, masks positions >= ctx_len
+    to -1e30, and softmaxes in f32 — identical masking/accumulation to
+    ``_dense_attention``, so paged decode logits sit within ulps of the
+    full-context recompute (tests/test_decode.py pins it).
+    """
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    n, p = k_pool.shape[0], k_pool.shape[1]
+    s, h, d = q.shape
+    mpp = page_table.shape[1]
+    idx = jnp.clip(page_table, 0, n - 1)
+    k = k_pool[idx].reshape(s, mpp * p, h, d)   # [S, T, H, D]
+    v = v_pool[idx].reshape(s, mpp * p, h, d)
+    scores = jnp.einsum('shd,sthd->sht', q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    valid = jnp.arange(mpp * p)[None, :] < ctx_len[:, None]  # [S, T]
+    scores = jnp.where(valid[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum('sht,sthd->shd', probs, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+@register_op('paged_attention')
+def _paged_attention(ctx, ins, attrs):
+    q = first(ins, 'Q')              # [S, H, D]
+    k_pool = first(ins, 'KPool')     # [N, P, H, D]
+    v_pool = first(ins, 'VPool')
+    page_table = first(ins, 'PT')    # [S, MPP] int32
+    ctx_len = first(ins, 'CtxLen')   # [S] int32
+    return out(paged_attention_math(
+        q, k_pool, v_pool, page_table.astype(jnp.int32),
+        ctx_len.astype(jnp.int32), scale=attrs.get('scale', None)))
+
+
 @register_op('flash_attention')
 def _flash_attention(ctx, ins, attrs):
     q = first(ins, 'Q')  # [B, T, H, D] or [B, T, D]

@@ -42,8 +42,8 @@ from ..core import datatypes
 from ..core.registry import COST_MAC, cost_class, op_traits
 from . import passes
 
-__all__ = ['analyze_cost', 'op_cost', 'MAC_FORMULAS', 'WAIVED_OPS',
-           'FLOPS_BASIS']
+__all__ = ['analyze_cost', 'op_cost', 'MAC_FORMULAS', 'BYTES_FORMULAS',
+           'WAIVED_OPS', 'FLOPS_BASIS', 'decode_step_cost']
 
 FLOPS_BASIS = ('FLOPs = 2 x MACs from closed-form per-op formulas '
                '(registry.COST_MAC); elementwise/reduction ops cost '
@@ -270,6 +270,28 @@ def _macs_vocab_ce(ins, outs, attrs, unknown):
     return n * d * int(w[0][1])
 
 
+def _macs_paged_attention(ins, outs, attrs, unknown):
+    # decode-step attention: per stream, q·K^T + P·V over the stream's
+    # gathered page span T = MPP * page_size — 2 * S*H*T*D MACs.  The
+    # closed per-token form: a stream with context t costs 2*H*t*D, and
+    # the padded span is the compiled upper bound actually executed.
+    q = _first(ins, 'Q')
+    kp = _first(ins, 'KPool')
+    pt = _first(ins, 'PT')
+    if q is None or kp is None or pt is None:
+        return None
+    if len(q[0]) != 3 or len(kp[0]) != 4 or len(pt[0]) != 2:
+        return None
+    s, h, d = q[0]
+    p = kp[0][1]
+    mpp = pt[0][1]
+    for v in (s, h, d, p, mpp):
+        if v is None or v < 0:
+            unknown[0] += 1
+            return None
+    return 2 * int(s) * int(h) * int(mpp) * int(p) * int(d)
+
+
 MAC_FORMULAS = {
     'mul': _macs_mul,
     'matmul': _macs_matmul,
@@ -286,9 +308,67 @@ MAC_FORMULAS = {
     'gru': _macs_gru,
     'gru_unit': _macs_gru_unit,
     'flash_attention': _macs_flash_attention,
+    'paged_attention': _macs_paged_attention,
     'fused_linear_softmax_ce': _macs_vocab_ce,
     'vocab_parallel_ce': _macs_vocab_ce,
 }
+
+
+def _bytes_paged_attention(ins, outs, attrs, unknown):
+    # the generic in+out tally would charge the WHOLE page pool per
+    # step; the step only reads the pages its page tables name.  KV
+    # read = 2 * S * MPP * page_size * H * D * dtype, plus q/out/table
+    # traffic.
+    q = _first(ins, 'Q')
+    kp = _first(ins, 'KPool')
+    pt = _first(ins, 'PT')
+    cl = _first(ins, 'CtxLen')
+    o = _first(outs, 'Out')
+    if q is None or kp is None or pt is None:
+        return None
+    if len(kp[0]) != 4 or len(pt[0]) != 2:
+        return None
+    s = _prod(pt[0][:1], unknown)
+    mpp = int(pt[0][1])
+    p, h, d = (int(x) for x in kp[0][1:])
+    kv = 2 * s * mpp * p * h * d * _dtype_bytes(kp[1])
+    return (kv + _spec_bytes(q, unknown) + _spec_bytes(o, unknown)
+            + _spec_bytes(pt, unknown) + _spec_bytes(cl, unknown))
+
+
+# Per-op overrides of the generic bytes tally (inputs read + outputs
+# written at full extent).  Needed where an input is a POOL the op only
+# partially touches — charging the whole resident buffer per step would
+# make the roofline position nonsense.  Same calling convention as
+# MAC_FORMULAS; None falls back to the generic tally.
+BYTES_FORMULAS = {
+    'paged_attention': _bytes_paged_attention,
+}
+
+
+def decode_step_cost(n_layers, d_model, n_heads, d_ff, vocab_size,
+                     streams, ctx_len, dtype_bytes=4):
+    """Closed-form cost of ONE continuous-batching decode step: S
+    streams each generate one token against a mean context of
+    ``ctx_len`` cached positions.  FLOPs = 2 x MACs (matmul projections
+    + per-token attention); bytes = the params read once per step (the
+    batch-S decode step is bandwidth-bound on weights at small S) plus
+    the KV-cache read/write traffic.  This is the on-chip model
+    benchmarks/bench_serving.py's decode scenario prints next to the
+    measured CPU-smoke numbers (PERF.md round 19)."""
+    s, t = int(streams), int(ctx_len)
+    d, f, v, h = int(d_model), int(d_ff), int(vocab_size), int(n_heads)
+    head_dim = d // max(h, 1)
+    per_layer_macs = s * (d * 3 * d + d * d + d * f + f * d) \
+        + 2 * s * h * t * head_dim
+    macs = n_layers * per_layer_macs + s * d * v
+    param_bytes = (n_layers * (3 * d * d + d * d + d * f + f * d)
+                   + v * d) * dtype_bytes
+    # KV traffic: read the whole context per layer, write one position
+    kv_bytes = n_layers * 2 * s * (t + 1) * d * dtype_bytes
+    return {'flops': 2 * int(macs),
+            'bytes': int(param_bytes + kv_bytes),
+            'kv_bytes': int(kv_bytes)}
 
 
 def _structurally_waived(op):
@@ -306,11 +386,16 @@ def op_cost(op_type, in_specs, out_specs, attrs):
     ``{'class', 'macs', 'flops', 'bytes', 'unknown_dims'}`` or None
     when the needed shapes are missing."""
     unknown = [0]
-    nbytes = 0
-    for specs in (in_specs, out_specs):
-        for slot, vals in specs.items():
-            for s in vals:
-                nbytes += _spec_bytes(s, unknown)
+    nbytes = None
+    bfn = BYTES_FORMULAS.get(op_type)
+    if bfn is not None:
+        nbytes = bfn(in_specs, out_specs, attrs, unknown)
+    if nbytes is None:
+        nbytes = 0
+        for specs in (in_specs, out_specs):
+            for slot, vals in specs.items():
+                for s in vals:
+                    nbytes += _spec_bytes(s, unknown)
     cls = cost_class(op_type)
     macs = 0
     if cls == 'mac':

@@ -57,7 +57,23 @@ Chrome trace counter track (``ph:"C"``) next to the measured
 from ..core import registry
 from . import cost_model as _cm
 
-__all__ = ['analyze_memory', 'WAIVED_OPS']
+__all__ = ['analyze_memory', 'page_pool_bytes', 'WAIVED_OPS']
+
+
+def page_pool_bytes(num_pages, page_size, num_heads, head_dim,
+                    dtype='float32', n_layers=1, kv=2):
+    """Modeled HBM residency of the decode engine's paged KV cache:
+    ``n_layers x kv x num_pages x page_size x num_heads x head_dim x
+    dtype`` bytes.  The pools live OUTSIDE any program (engine-held,
+    donated chunk→chunk through the decode step), so the liveness walk
+    never sees them — this closed form is how the engine reports
+    ``resident_bytes`` and what the golden test pins
+    (tests/test_memory_model.py)."""
+    import numpy as np
+    from ..core import datatypes
+    itemsize = np.dtype(datatypes.as_numpy_dtype(dtype)).itemsize
+    return (int(n_layers) * int(kv) * int(num_pages) * int(page_size)
+            * int(num_heads) * int(head_dim) * int(itemsize))
 
 # Ops with NO per-op live-bytes verdict — same data-dependent-extent
 # set the cost model waives (minus 'autodiff', which this model DOES

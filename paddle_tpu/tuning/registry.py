@@ -291,3 +291,24 @@ register_tunable(
     scope='bench',
     help='steps per run_steps scan — amortizes the per-call dispatch '
          'round trip; consumed by the bench harness')
+register_tunable(
+    'decode_page_size', (8, 16, 32, 64, 128),
+    default=16, subsystem='inference.decode',
+    env='PADDLE_TPU_DECODE_PAGE_SIZE',
+    help='KV-cache page granularity (tokens per page): small pages '
+         'waste less on ragged tails but grow the page table; large '
+         'pages read denser but strand capacity on short streams')
+register_tunable(
+    'decode_max_streams', (2, 4, 8, 16, 32),
+    default=8, subsystem='inference.decode',
+    env='PADDLE_TPU_DECODE_MAX_STREAMS',
+    help='decode step width (streams batched per token step): wider '
+         'amortizes the weight read across streams but multiplies '
+         'the page pool the admission check must cover')
+register_tunable(
+    'decode_prefill_bucket', (32, 64, 128, 256, 512),
+    default=128, subsystem='inference.decode',
+    env='PADDLE_TPU_DECODE_PREFILL_BUCKET',
+    help='prompt-length bucket ladder top for prefill (powers of two '
+         'up to this, clamped to the model context): taller ladders '
+         'pad long prompts less but compile more variants at warmup')

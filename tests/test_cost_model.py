@@ -269,3 +269,57 @@ def test_bf16_program_counts_low_precision_bytes():
     assert len(muls_f32) == len(muls_bf16) == 2
     for lo, hi in zip(muls_bf16, muls_f32):
         assert lo < hi
+
+
+# -- golden: paged_attention decode step (PR-19) ---------------------------
+
+def test_paged_attention_golden_macs_and_bytes():
+    """Hand-derived from the spec shapes: S=3 streams, H=2 heads,
+    D=8 head_dim, pool pages of 4 tokens, page tables 4 pages wide.
+    MACs = 2*S*H*MPP*P*D; bytes = KV read over the gathered span (NOT
+    the whole resident pool) + q/out/table traffic."""
+    ins = {'Q': [((3, 2, 8), 'float32')],
+           'KPool': [((17, 4, 2, 8), 'float32')],
+           'VPool': [((17, 4, 2, 8), 'float32')],
+           'PT': [((3, 4), 'int32')],
+           'CtxLen': [((3,), 'int32')]}
+    outs = {'Out': [((3, 2, 8), 'float32')]}
+    got = cost_model.op_cost('paged_attention', ins, outs, {})
+    assert got['macs'] == 2 * 3 * 2 * 4 * 4 * 8 == 1536
+    assert got['flops'] == 2 * 1536
+    # kv 2*3*4*4*2*8*4 = 6144, q 192, out 192, pt 48, ctx 12
+    assert got['bytes'] == 6144 + 192 + 192 + 48 + 12 == 6588
+    # the override matters: the generic tally would charge both whole
+    # pools — 2 * 17*4*2*8*4 = 8704 bytes of pool alone
+    assert got['bytes'] < 2 * 17 * 4 * 2 * 8 * 4 + 192 + 192 + 48 + 12
+    assert got['unknown_dims'] == 0
+
+
+def test_bytes_formulas_fall_back_to_generic_tally():
+    """A BYTES_FORMULAS entry returning None (rank-mismatched specs)
+    must fall back to the generic in+out tally, and ops without an
+    override must tally generically."""
+    ins = {'Q': [((3, 2, 8), 'float32')],
+           'KPool': [((4, 2, 8), 'float32')],   # rank 3: not a pool
+           'PT': [((3, 4), 'int32')]}
+    outs = {'Out': [((3, 2, 8), 'float32')]}
+    assert cost_model._bytes_paged_attention(ins, outs, {}, [0]) is None
+    got = cost_model.op_cost('relu', {'X': ins['Q']}, outs, {})
+    assert got['bytes'] == (3*2*8*4) + (3*2*8*4)
+
+
+def test_decode_step_cost_golden():
+    """One continuous-batching decode step for the flagship's test
+    config: L=2, D=32, H=4, F=128, V=64, S=4 streams at mean context
+    t=24.  Every term derived by hand."""
+    got = cost_model.decode_step_cost(
+        n_layers=2, d_model=32, n_heads=4, d_ff=128, vocab_size=64,
+        streams=4, ctx_len=24)
+    proj_macs = 4 * (32*96 + 32*32 + 32*128 + 128*32)   # qkv+proj+ffn
+    attn_macs = 2 * 4 * 4 * 24 * 8                      # 2*S*H*t*Dh
+    macs = 2 * (proj_macs + attn_macs) + 4 * 32 * 64    # + vocab head
+    assert got['flops'] == 2 * macs == 237568
+    param_bytes = (2 * (32*96 + 32*32 + 32*128 + 128*32) + 64*32) * 4
+    kv_bytes = 2 * 2 * 4 * 25 * 32 * 4                  # read t, write 1
+    assert got['kv_bytes'] == kv_bytes == 51200
+    assert got['bytes'] == param_bytes + kv_bytes == 157696

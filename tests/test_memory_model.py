@@ -318,3 +318,34 @@ def test_overlap_credit_composes_with_remat(monkeypatch):
     assert dots_on['peak_bytes'] == \
         dots_off['peak_bytes'] + _GRAD_BYTES
     assert dots_on['peak_bytes'] <= full_on['peak_bytes']
+
+
+# -- golden: decode page pool (PR-19) --------------------------------------
+
+def test_page_pool_bytes_golden():
+    """The acceptance golden: pool bytes = num_pages x page_size x
+    heads x head_dim x dtype itemsize, times layers and the K/V pair."""
+    assert memory_model.page_pool_bytes(
+        16, 8, 4, 32, dtype='float32', n_layers=1, kv=1) == \
+        16 * 8 * 4 * 32 * 4
+    # both pools, every layer
+    assert memory_model.page_pool_bytes(
+        16, 8, 4, 32, dtype='float32', n_layers=3, kv=2) == \
+        3 * 2 * 16 * 8 * 4 * 32 * 4
+    # dtype scales by itemsize
+    assert memory_model.page_pool_bytes(
+        16, 8, 4, 32, dtype='bfloat16') == \
+        memory_model.page_pool_bytes(16, 8, 4, 32) // 2
+
+
+def test_page_pool_bytes_matches_live_cache():
+    """The model charges exactly what the engine keeps resident — the
+    trash page included (the cache reports num_pages+1)."""
+    from paddle_tpu.inference.decode import PagedKVCache
+    cache = PagedKVCache(n_layers=2, num_pages=8, page_size=4,
+                         n_heads=2, head_dim=8)
+    assert cache.resident_bytes() == memory_model.page_pool_bytes(
+        9, 4, 2, 8, dtype='float32', n_layers=2, kv=2)
+    assert cache.resident_bytes() == \
+        sum(int(np.prod(pool.shape)) * pool.dtype.itemsize
+            for pool in (cache.k, cache.v))

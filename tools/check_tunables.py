@@ -40,6 +40,7 @@ _MAX_DOMAIN = 64
 _REQUIRED = (
     'flat_tile_budget', 'amp', 'mesh',
     'overlap', 'overlap_bucket_mb', 'pp_microbatches',
+    'decode_page_size', 'decode_max_streams', 'decode_prefill_bucket',
 )
 
 
