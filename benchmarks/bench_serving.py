@@ -192,6 +192,8 @@ def main():
     results.extend(multitenant_scenario(tpu))
     results.extend(online_scenario(tpu))
     results.extend(decode_scenario(tpu))
+    results.extend(decode_prefix_scenario(tpu))
+    results.extend(decode_chunked_scenario(tpu))
     # attach the observability snapshot so BENCH_*.json runs carry the
     # queue/occupancy/latency telemetry behind the headline numbers
     # (empty when PADDLE_TPU_METRICS_ENABLED=0 — servers then report to
@@ -1227,10 +1229,6 @@ def dynamic_scenario(tpu):
     return results
 
 
-if __name__ == '__main__':
-    main()
-
-
 def decode_scenario(tpu):
     """Autoregressive decode under open-loop Poisson traffic (ISSUE 19):
     streams of MIXED prompt/generation lengths arrive at random times
@@ -1361,3 +1359,258 @@ def decode_scenario(tpu):
         "continuous batching must beat the generation-batch baseline: "
         "%r" % throughput)
     return results
+
+
+def _decode_model(tpu, seed=19, **over):
+    """The decode-bench transformer (same shapes as decode_scenario),
+    built once per scenario: returns (params, cfg).  Keyword overrides
+    replace cfg entries before the build."""
+    import paddle_tpu as fluid
+    from paddle_tpu.inference.decode import extract_params
+    from paddle_tpu.models import transformer
+
+    if tpu:
+        cfg = dict(L=6, D=512, H=8, V=30000, T=512,
+                   page=16, streams=16, bucket=256)
+    else:
+        cfg = dict(L=2, D=64, H=4, V=200, T=64,
+                   page=8, streams=4, bucket=32)
+    cfg.update(over)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main_p, startup):
+            transformer.build(vocab_size=cfg['V'], seq_len=cfg['T'],
+                              n_layers=cfg['L'], d_model=cfg['D'],
+                              n_heads=cfg['H'])
+        exe = fluid.Executor(fluid.TPUPlace(0) if tpu
+                             else fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        return extract_params(scope, cfg['L']), cfg
+
+
+def decode_prefix_scenario(tpu):
+    """Prefix-cached KV page reuse (ISSUE 20): the agent/few-shot
+    traffic shape — every request shares a common preamble (system
+    prompt + exemplars) and differs only in a short suffix — served
+    prefix-on vs prefix-off over the SAME seed-pinned Poisson arrival
+    schedule.  Reports TTFT p50/p99 for both treatments, the prefix
+    hit rate, and the closed-form prefill MACs split cached vs
+    computed (cost_model.prefill_cost — the cached share is work the
+    reuse path never issues).  The bar: hit rate >= 0.5, prefix-on
+    TTFT p99 strictly below prefix-off, zero post-warmup compiles."""
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeServer
+    from paddle_tpu.transpiler.cost_model import prefill_cost
+
+    # CPU smoke needs a prefill-heavy shape: at the default D=64 a
+    # monolithic bucket call and a single tail chunk both cost the
+    # same ~0.8ms XLA dispatch floor, so the cached-span skip has no
+    # wall-clock signal to show — widen until prefill math dominates
+    params, cfg = _decode_model(tpu) if tpu else \
+        _decode_model(False, D=256, V=8000)
+    page = cfg['page']
+    bucket = cfg['bucket'] if tpu else cfg['T']
+    n_req = 48 if tpu else 24
+    pre_len = 4 * page         # page-aligned few-shot preamble
+    suf_len = 8 if tpu else 6
+    max_new = 8 if tpu else 6
+    rng = np.random.default_rng(11)
+    preamble = rng.integers(1, cfg['V'], pre_len).astype(np.int64)
+    prompts = [np.concatenate([
+        preamble, rng.integers(1, cfg['V'], suf_len).astype(np.int64)])
+        for _ in range(n_req)]
+    gaps = rng.exponential(0.001, n_req)
+
+    engines = {}
+    for label, on in (('on', True), ('off', False)):
+        eng = DecodeEngine(params, n_layers=cfg['L'],
+                           n_heads=cfg['H'], page_size=page,
+                           max_streams=cfg['streams'],
+                           prefill_bucket=bucket,
+                           prefix_cache=on)
+        eng.warmup()
+        engines[label] = eng
+
+    def run(label):
+        srv = DecodeServer(engines[label])
+        h0 = srv.stats()['prefix_hit_tokens']
+        if label == 'on':
+            # seed the trie: the one cold miss is this treatment's
+            # warmup, not a sample of its steady state (repeat runs
+            # hit the already-populated trie, which IS the steady
+            # state the cache converges to under this traffic)
+            srv.submit(prompts[0],
+                       max_new_tokens=1).result(timeout=120.0)
+            h0 = srv.stats()['prefix_hit_tokens']
+        streams = []
+        for gap, p in zip(gaps, prompts):
+            time.sleep(float(gap))
+            streams.append(srv.submit(p, max_new_tokens=max_new))
+        assert srv.drain(timeout=600.0), "prefix drain timed out"
+        stats = srv.stats()
+        srv.close()
+        assert stats['dropped'] == 0, stats
+        assert stats['compiles_after_warmup'] == 0, stats
+        ttfts = np.asarray([st.ttft_s for st in streams]) * 1e3
+        hit = stats['prefix_hit_tokens'] - h0
+        miss = sum(len(p) for p in prompts) - hit
+        return (float(np.percentile(ttfts, 99)),
+                float(np.percentile(ttfts, 50)),
+                hit / max(hit + miss, 1), hit, stats)
+
+    # interleaved repeats, median p99 per treatment: a single
+    # p99-vs-p99 comparison between two runs seconds apart measures
+    # 2-core box weather, not the scheduler
+    repeats = 3
+    samples = {'on': [], 'off': []}
+    for _ in range(repeats):
+        for label in ('on', 'off'):
+            samples[label].append(run(label))
+
+    results = []
+    p99 = {}
+    for label in ('on', 'off'):
+        runs = samples[label]
+        p99[label] = float(np.median([r[0] for r in runs]))
+        p50 = float(np.median([r[1] for r in runs]))
+        hit_rate = runs[-1][2]
+        stats = runs[-1][4]
+        flops_computed = flops_cached = 0
+        for p in prompts:
+            c = prefill_cost(cfg['L'], cfg['D'], cfg['H'],
+                             4 * cfg['D'], cfg['V'], len(p),
+                             cached_len=pre_len if label == 'on'
+                             else 0)
+            flops_computed += c['flops']
+            flops_cached += c['flops_cached']
+        r = {"metric": "decode_prefix_ttft_ms",
+             "value": round(p99[label], 2), "unit": "ms p99",
+             "prefix_cache": label,
+             "p50_ttft_ms": round(p50, 2),
+             "p99_ttft_ms": round(p99[label], 2),
+             "p99_samples": [round(x[0], 2) for x in runs],
+             "prefix_hit_rate": round(hit_rate, 3),
+             "prefix_hit_tokens": runs[-1][3],
+             "prefill_gflops_computed": round(flops_computed / 1e9, 4),
+             "prefill_gflops_cached": round(flops_cached / 1e9, 4),
+             "cached_pages": stats['cached_pages'],
+             "compiles_after_warmup": stats['compiles_after_warmup'],
+             "note": "%d streams sharing a %d-token preamble + %d-token"
+                     " unique suffix, Poisson mean gap 1ms, median of "
+                     "%d interleaved runs"
+                     % (n_req, pre_len, suf_len, repeats)}
+        print(json.dumps(r))
+        results.append(r)
+        if label == 'on':
+            assert hit_rate >= 0.5, (
+                "prefix hit rate %.3f below the 0.5 bar" % hit_rate)
+    assert p99['on'] < p99['off'], (
+        "prefix-on TTFT p99 must beat prefix-off: %r" % p99)
+    return results
+
+
+def decode_chunked_scenario(tpu):
+    """Chunked prefill bounds head-of-line blocking (ISSUE 20): three
+    short-prompt streams decode continuously while long-prompt streams
+    inject mid-run; the victims' inter-token latency p99 is compared
+    against the same streams with NO injection.  The chunked engine
+    (per-tick prefill budget of one page) must hold the ratio at
+    <= 1.5x; the monolithic engine — which prefills each long prompt
+    in one tick-blocking call — runs the same schedule as the
+    recorded contrast."""
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeServer
+
+    if tpu:
+        params, cfg = _decode_model(True)
+    else:
+        # step-heavy smoke shape: the 1.5x bound is about a page-sized
+        # chunk hiding inside a decode step that dominates the tick.
+        # At the default smoke width a sub-ms step would be swamped by
+        # the ~0.8ms XLA dispatch floor of the EXTRA per-tick chunk
+        # call — measuring the host, not the scheduler — so widen the
+        # model and the slot count until the step carries the tick
+        params, cfg = _decode_model(False, D=256, V=8000,
+                                    page=4, streams=16)
+    page = cfg['page']
+    n_short = cfg['streams'] - 1
+    short_new = 64 if tpu else 44
+    # injected prompts span (nearly) the full context with the prefill
+    # ladder opened up to match: the monolithic treatment prefills
+    # each one in a single tick-blocking top-bucket call, which is the
+    # head-of-line block chunking exists to break up
+    bucket = cfg['T']
+    long_len, long_new = cfg['T'] - 8, 4
+    n_long = 6
+    rng = np.random.default_rng(13)
+    short_prompts = [rng.integers(1, cfg['V'], 4).astype(np.int64)
+                     for _ in range(n_short)]
+    long_prompts = [rng.integers(1, cfg['V'], long_len).astype(np.int64)
+                    for _ in range(n_long)]
+
+    def run(eng, inject):
+        srv = DecodeServer(eng)
+        shorts = [srv.submit(p, max_new_tokens=short_new)
+                  for p in short_prompts]
+        deadline = time.perf_counter() + 120.0
+        while not all(st.tokens for st in shorts) and \
+                time.perf_counter() < deadline:
+            time.sleep(0.001)   # all victims decoding before injection
+        if inject:
+            for p in long_prompts:
+                srv.submit(p, max_new_tokens=long_new)
+        assert srv.drain(timeout=600.0), "chunked drain timed out"
+        stats = srv.stats()
+        srv.close()
+        assert stats['dropped'] == 0, stats
+        assert stats['compiles_after_warmup'] == 0, stats
+        # steady-state ITL: drop each victim's first few intervals —
+        # they straddle admission and the first post-warmup dispatches,
+        # cold-start jitter common to both treatments
+        itl = np.concatenate([st.per_token_s()[5:]
+                              for st in shorts]) * 1e3
+        return float(np.percentile(itl, 99)), stats
+
+    results = []
+    ratios = {}
+    repeats = 3   # interleaved repeats, median p99 per treatment:
+    #               a single p99-vs-p99 comparison between two runs
+    #               half a second apart measures 2-core box weather
+    for label, chunk in (('chunked', page), ('monolithic', 0)):
+        eng = DecodeEngine(params, n_layers=cfg['L'],
+                           n_heads=cfg['H'], page_size=page,
+                           max_streams=cfg['streams'],
+                           prefill_bucket=bucket,
+                           prefill_chunk_tokens=chunk)
+        eng.warmup()
+        base_p99s, inj_p99s = [], []
+        for _ in range(repeats):
+            base_p99s.append(run(eng, inject=False)[0])
+            inj_p99, stats = run(eng, inject=True)
+            inj_p99s.append(inj_p99)
+        base_p99 = float(np.median(base_p99s))
+        inj_p99 = float(np.median(inj_p99s))
+        ratios[label] = inj_p99 / max(base_p99, 1e-9)
+        r = {"metric": "decode_itl_injection_ratio",
+             "value": round(ratios[label], 2),
+             "unit": "x no-injection p99",
+             "prefill": label,
+             "itl_p99_ms_baseline": round(base_p99, 2),
+             "itl_p99_ms_injected": round(inj_p99, 2),
+             "baseline_samples": [round(x, 2) for x in base_p99s],
+             "injected_samples": [round(x, 2) for x in inj_p99s],
+             "prefill_chunks": stats['prefill_chunks'],
+             "compiles_after_warmup": stats['compiles_after_warmup'],
+             "note": "%d victims decoding %d tokens; %d injected "
+                     "%d-token prompts" % (n_short, short_new,
+                                           n_long, long_len)}
+        print(json.dumps(r))
+        results.append(r)
+    assert ratios['chunked'] <= 1.5, (
+        "chunked prefill must bound victim ITL p99 at 1.5x the "
+        "no-injection baseline: %r" % ratios)
+    return results
+
+
+if __name__ == '__main__':
+    main()

@@ -49,6 +49,7 @@ AMP_WHITE = frozenset({
     'conv2d', 'conv2d_transpose', 'conv3d', 'conv3d_transpose',
     'sequence_conv', 'conv_shift', 'row_conv',
     'bilinear_tensor_product', 'flash_attention', 'paged_attention',
+    'chunked_prefill_attention',
     'lstm', 'lstm_unit', 'gru', 'gru_unit',
     # fused vocab-head CE ops: dominated by the [N,D]x[D,V] matmul and
     # internally f32-safe (preferred_element_type accumulation + f32

@@ -473,6 +473,32 @@ DEFINE_int('decode_prefill_bucket', 128,
            'only ~log2 distinct prefill shapes ever compile; prompts '
            'longer than the top bucket are rejected at submit.  A '
            'registered tunable')
+DEFINE_bool('decode_prefix_cache', False,
+            'radix/trie prefix cache over the decode engine KV pages '
+            '(inference/decode.py): page-aligned prompt prefixes map '
+            'to ref-counted cached pages, a hitting stream claims them '
+            'by reference and prefilles only the tail (zero MACs for '
+            'the shared span); unreferenced pages LRU-evict under pool '
+            'pressure.  Enabling switches prefill to the chunked '
+            'executables (grid-aligned chunks, bitwise hit-vs-cold). '
+            'A registered tunable (tuning/registry.py)')
+DEFINE_int('decode_prefill_chunk_tokens', 0,
+           'per-tick prefill token budget for chunked prefill in the '
+           'decode worker loop: prompts prefill in page-aligned chunks '
+           'of up to this many tokens between decode steps, so a long '
+           'prompt no longer stalls running streams for one monolithic '
+           'bucket dispatch.  0 = no per-tick budget (a stream\'s '
+           'whole prefill runs at admission; chunked executables are '
+           'still used when the prefix cache is on).  A registered '
+           'tunable')
+DEFINE_int('decode_page_reserve', 2,
+           'free-page watermark the decode admission keeps in reserve '
+           'when incremental page allocation is active (prefix cache '
+           'or chunked prefill on): a stream admits only while '
+           'free >= tail_pages + reserve, leaving headroom so running '
+           'streams\' claim-as-context-grows page faults rarely hit an '
+           'empty pool (exhaustion preempts the youngest stream back '
+           'to the queue, recompute-on-resume).  A registered tunable')
 DEFINE_float('peak_tflops', 0.0,
              'device peak TFLOP/s for MFU and roofline accounting '
              '(bench.py, benchmarks/common.py, tuning/roofline.py): '

@@ -49,9 +49,17 @@ from ..core.registry import get_op_impl
 from ..transpiler.memory_model import page_pool_bytes
 
 __all__ = ['DecodeEngine', 'DecodeServer', 'DecodeStream',
-           'extract_params', 'decode_buckets']
+           'extract_params', 'decode_buckets', 'PrefixCache',
+           'PromptTooLongError']
 
 _server_seq = itertools.count()
+
+
+class PromptTooLongError(ValueError):
+    """A submitted prompt cannot be served: longer than the top prefill
+    bucket (monolithic prefill), or prompt+max_new exceeds the model
+    context.  Subclasses ValueError so pre-existing callers' handlers
+    keep working; raised in the SUBMITTING thread, never the worker."""
 
 
 def extract_params(scope, n_layers):
@@ -162,6 +170,125 @@ class PagedKVCache(object):
                                self.k.dtype, n_layers=self.n_layers)
 
 
+class _PrefixNode(object):
+    """One cached page: the KV of ``key`` (a page_size token tuple)
+    computed under the prefix its trie path spells."""
+    __slots__ = ('key', 'page', 'parent', 'children', 'refs',
+                 'last_use')
+
+    def __init__(self, key, page, parent):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children = {}
+        self.refs = 0
+        self.last_use = 0
+
+
+class PrefixCache(object):
+    """Radix trie over token sequences mapping page-aligned prefixes to
+    ref-counted KV pages (RadixAttention-style reuse over this engine's
+    page-table indirection).
+
+    Host state owned by the decode worker thread, like the pool free
+    list — no lock of its own.  A node's page holds the KV a prefill
+    computed for ``key`` under the node's path; because chunked prefill
+    runs on an absolute position grid, that KV is BITWISE identical for
+    every stream sharing the prefix, so a hit claims the pages by
+    reference and reproduces the cold logits exactly.  Ownership rules:
+
+    - ``match`` acquires a ref per matched node; the stream holds it
+      until retire (or preemption) and ``release``s it.
+    - ``insert`` ADOPTS the caller's page for any prefix page not yet
+      cached (ownership moves to the trie); an already-cached page is
+      skipped — the caller keeps its private copy and frees it itself.
+    - ``evict`` only ever frees unreferenced LEAF pages, LRU-first; a
+      referenced page (refs > 0) or an interior node is untouchable.
+    """
+
+    def __init__(self, page_size):
+        self.page_size = int(page_size)
+        self._root = _PrefixNode(None, None, None)
+        self._clock = 0
+        self.cached_pages = 0
+
+    def _tick(self):
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens):
+        """Longest cached page-aligned prefix of ``tokens``: returns
+        (pages, nodes) root-first, one ref acquired per node."""
+        P = self.page_size
+        node, pages, nodes = self._root, [], []
+        t = len(tokens)
+        i = 0
+        while i + P <= t:
+            child = node.children.get(
+                tuple(int(x) for x in tokens[i:i + P]))
+            if child is None:
+                break
+            child.refs += 1
+            child.last_use = self._tick()
+            nodes.append(child)
+            pages.append(child.page)
+            node = child
+            i += P
+        return pages, nodes
+
+    def release(self, nodes):
+        for n in nodes:
+            n.refs -= 1
+            n.last_use = self._tick()
+
+    def insert(self, tokens, pages, acquire=False):
+        """Walk the full pages of ``tokens`` (pages[i] backs page i),
+        creating nodes for uncached pages.  Returns (nodes,
+        adopted_indices): the caller no longer owns pages at adopted
+        indices.  With ``acquire`` every node on the path gains a ref
+        (the caller must later ``release`` the returned nodes)."""
+        P = self.page_size
+        node, nodes, adopted = self._root, [], []
+        n_full = min(len(tokens) // P, len(pages))
+        for i in range(n_full):
+            key = tuple(int(x) for x in tokens[i * P:(i + 1) * P])
+            child = node.children.get(key)
+            if child is None:
+                child = _PrefixNode(key, int(pages[i]), node)
+                node.children[key] = child
+                adopted.append(i)
+                self.cached_pages += 1
+            if acquire:
+                child.refs += 1
+            child.last_use = self._tick()
+            nodes.append(child)
+            node = child
+        return nodes, adopted
+
+    def evict(self, want):
+        """Free up to ``want`` pages from unreferenced leaves,
+        least-recently-used first.  Returns the freed page ids (the
+        caller hands them back to the pool free list).  Referenced
+        pages are never candidates — pool pressure can starve a new
+        admission, but never corrupt a live stream's context."""
+        freed = []
+        while len(freed) < int(want):
+            best, stack = None, list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                if n.children:
+                    stack.extend(n.children.values())
+                elif n.refs == 0 and (best is None
+                                      or n.last_use < best.last_use):
+                    best = n
+            if best is None:
+                break  # every leaf referenced: nothing evictable
+            del best.parent.children[best.key]
+            freed.append(best.page)
+            self.cached_pages -= 1
+        return freed
+
+
 class DecodeEngine(object):
     """Compiled prefill/pack/decode executables over one weight set.
 
@@ -172,6 +299,7 @@ class DecodeEngine(object):
 
     def __init__(self, params, n_layers, n_heads, page_size=None,
                  num_pages=None, max_streams=None, prefill_bucket=None,
+                 prefix_cache=None, prefill_chunk_tokens=None,
                  dtype=jnp.float32):
         from ..flags import FLAGS
         self.params = {n: jnp.asarray(v) for n, v in params.items()}
@@ -195,10 +323,36 @@ class DecodeEngine(object):
         self.cache = PagedKVCache(self.n_layers, num_pages,
                                   self.page_size, self.n_heads,
                                   self.head_dim, dtype)
+        self.prefix_enabled = bool(FLAGS.decode_prefix_cache
+                                   if prefix_cache is None
+                                   else prefix_cache)
+        self.chunk_tokens = int(FLAGS.decode_prefill_chunk_tokens
+                                if prefill_chunk_tokens is None
+                                else prefill_chunk_tokens)
+        # chunked prefill path: active when either feature is on.  The
+        # chunk GRID is anchored at absolute position 0, so a prefix
+        # hit's tail chunks are an exact suffix of the cold chunk list
+        # — the foundation of bitwise hit-vs-cold parity.  Both off ->
+        # the monolithic bucket prefill, verbatim.
+        self.chunked = self.prefix_enabled or self.chunk_tokens > 0
+        if self.chunked:
+            g = max(self.page_size,
+                    (self.chunk_tokens // self.page_size)
+                    * self.page_size)
+            self.chunk_grid = min(g, self.buckets[-1])
+            top = next(b for b in self.buckets
+                       if b >= self.chunk_grid)
+            self.chunk_buckets = [b for b in self.buckets if b <= top]
+        else:
+            self.chunk_grid = None
+            self.chunk_buckets = []
+        self.prefix = PrefixCache(self.page_size) \
+            if self.prefix_enabled else None
         self.compiles_total = 0
         self._compiles_at_warmup = None
         self._prefill = {}   # bucket -> compiled (params, tokens)
         self._pack = {}      # bucket -> compiled (k, v, pools, pages)
+        self._chunk = {}     # bucket -> compiled chunked-prefill fn
         self._step = None
 
     # -- compiled function builders ------------------------------------
@@ -243,6 +397,70 @@ class DecodeEngine(object):
         self._pack[bucket] = self._compile(
             pack, self.cache.k, self.cache.v, kv, kv, pages,
             donate=(0, 1))
+
+    def _ensure_chunk(self, bucket):
+        """Chunked-prefill executable for one chunk bucket: a SINGLE
+        stream's prompt chunk of up to ``bucket`` tokens at absolute
+        positions pos0.., scattered into the stream's pages and
+        attending over chunks 0..N via the page table (the KV-carry is
+        the donated pool itself — the run_steps carry pattern at pool
+        granularity).  Returns the last VALID row's logits only, so
+        intermediate chunks pay one [D]x[D,V] row, not a [C,V] head."""
+        if bucket in self._chunk:
+            return
+        L, H, Dh, D = (self.n_layers, self.n_heads, self.head_dim,
+                       self.d_model)
+        P, mpp = self.page_size, self.pages_per_stream
+        params = self.params
+        trash = self.cache.trash
+        chunk_att = get_op_impl('chunked_prefill_attention').compute
+
+        def chunk(k_pool, v_pool, tokens, pt, pos0, n_valid):
+            # pos0 and n_valid are traced (host slicing would hide
+            # per-shape gather compiles, the _ensure_prefill lesson);
+            # padded rows (i >= n_valid) write to the trash page and
+            # their outputs never leave the executable
+            pos = pos0 + jnp.arange(bucket)
+            valid = jnp.arange(bucket) < n_valid
+            posc = jnp.clip(pos, 0, self.max_seq - 1)
+            x = params['tr_embed'][tokens] + params['tr_pos'][posc]
+            page_idx = pt[jnp.clip(pos // P, 0, mpp - 1)]
+            page_idx = jnp.where(valid, page_idx, trash)
+            offset = pos % P
+            for i in range(L):
+                p = 'tr_l%d_' % i
+                h = _ln(x, params[p + 'ln_attn_w'],
+                        params[p + 'ln_attn_b'])
+                qkv = h @ params[p + 'qkv_w'] + params[p + 'qkv_b']
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = q.reshape(bucket, H, Dh)
+                k = k.reshape(bucket, H, Dh).astype(k_pool.dtype)
+                v = v.reshape(bucket, H, Dh).astype(v_pool.dtype)
+                k_pool = k_pool.at[i, page_idx, offset].set(k)
+                v_pool = v_pool.at[i, page_idx, offset].set(v)
+                ctx = chunk_att(None, {'Q': [q],
+                                       'KPool': [k_pool[i]],
+                                       'VPool': [v_pool[i]],
+                                       'PT': [pt], 'Pos0': [pos0]},
+                                {})['Out'][0]
+                x = x + ctx.reshape(bucket, D) @ params[p + 'proj_w'] \
+                    + params[p + 'proj_b']
+                h = _ln(x, params[p + 'ln_ffn_w'],
+                        params[p + 'ln_ffn_b'])
+                h = jnp.maximum(h @ params[p + 'ffn_up_w']
+                                + params[p + 'ffn_up_b'], 0.0)
+                x = x + h @ params[p + 'ffn_down_w'] \
+                    + params[p + 'ffn_down_b']
+            x = _ln(x, params['tr_ln_f_w'], params['tr_ln_f_b'])
+            x_last = x[jnp.clip(n_valid - 1, 0, bucket - 1)]
+            logits = x_last @ params['tr_head_w'] + params['tr_head_b']
+            return k_pool, v_pool, logits
+
+        self._chunk[bucket] = self._compile(
+            chunk, self.cache.k, self.cache.v,
+            jnp.zeros((bucket,), jnp.int32),
+            jnp.full((mpp,), trash, jnp.int32),
+            jnp.int32(0), jnp.int32(1), donate=(0, 1))
 
     def _ensure_step(self):
         if self._step is not None:
@@ -307,19 +525,35 @@ class DecodeEngine(object):
         executables (compiles_after_warmup counts any miss)."""
         if self._compiles_at_warmup == self.compiles_total:
             return  # already compiled AND warm-executed, nothing new
-        for b in self.buckets:
-            self._ensure_prefill(b)
-        self._ensure_step()
         trash = self.cache.trash
-        for b in self.buckets:
-            logits, k, v = self._prefill[b](
-                self.params, jnp.zeros((b,), jnp.int32),
-                jnp.int32(0))
-            all_trash = jnp.full((b // self.page_size,), trash,
-                                 jnp.int32)
-            self.cache.k, self.cache.v = self._pack[b](
-                self.cache.k, self.cache.v, k, v, all_trash)
-            jax.block_until_ready(logits)
+        if self.chunked:
+            # chunked path: all prefill (cold included) runs the chunk
+            # executables — the monolithic prefill/pack pair is never
+            # dispatched, so warmup neither compiles nor warms it
+            for b in self.chunk_buckets:
+                self._ensure_chunk(b)
+            self._ensure_step()
+            mpp = self.pages_per_stream
+            for b in self.chunk_buckets:
+                self.cache.k, self.cache.v, logits = self._chunk[b](
+                    self.cache.k, self.cache.v,
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.full((mpp,), trash, jnp.int32),
+                    jnp.int32(0), jnp.int32(b))
+                jax.block_until_ready(logits)
+        else:
+            for b in self.buckets:
+                self._ensure_prefill(b)
+            self._ensure_step()
+            for b in self.buckets:
+                logits, k, v = self._prefill[b](
+                    self.params, jnp.zeros((b,), jnp.int32),
+                    jnp.int32(0))
+                all_trash = jnp.full((b // self.page_size,), trash,
+                                     jnp.int32)
+                self.cache.k, self.cache.v = self._pack[b](
+                    self.cache.k, self.cache.v, k, v, all_trash)
+                jax.block_until_ready(logits)
         S, mpp = self.max_streams, self.pages_per_stream
         self.cache.k, self.cache.v, logits, _ = self._step(
             self.cache.k, self.cache.v, jnp.zeros((S,), jnp.int32),
@@ -340,8 +574,9 @@ class DecodeEngine(object):
         for b in self.buckets:
             if prompt_len <= b:
                 return b
-        raise ValueError("prompt length %d exceeds top prefill bucket "
-                         "%d" % (prompt_len, self.buckets[-1]))
+        raise PromptTooLongError(
+            "prompt length %d exceeds top prefill bucket %d"
+            % (prompt_len, self.buckets[-1]))
 
     def prefill_into(self, prompt, pages):
         """Run one prompt's prefill and pack its K/V into ``pages``
@@ -362,6 +597,46 @@ class DecodeEngine(object):
         page_ids[:n_real] = pages[:n_real]
         self.cache.k, self.cache.v = self._pack[bucket](
             self.cache.k, self.cache.v, k, v, jnp.asarray(page_ids))
+        return np.asarray(logits)
+
+    def chunk_spans(self, prompt_len, start=0):
+        """The grid-aligned chunk decomposition of positions
+        [start, prompt_len): full ``chunk_grid`` chunks plus one ragged
+        remainder.  ``start`` must sit ON the grid — a prefix hit's
+        tail spans are then an exact suffix of the cold (start=0)
+        spans, which is what makes hit and cold prefill bitwise
+        identical executions."""
+        g = self.chunk_grid
+        if start % g:
+            raise ValueError("chunk start %d off the %d-token grid"
+                             % (start, g))
+        spans, lo = [], int(start)
+        while lo < prompt_len:
+            hi = min(lo + g, int(prompt_len))
+            spans.append((lo, hi))
+            lo = hi
+        return spans
+
+    def prefill_chunk(self, tokens, pages, pos0):
+        """Run ONE prefill chunk for a single stream: ``tokens`` [c]
+        (c <= chunk_grid) land at absolute positions pos0..pos0+c-1 in
+        the pages named by ``pages`` (the stream's page table; entries
+        past it route to trash).  Returns the chunk's last-row logits
+        as numpy [V] — only the final chunk's matter (the TTFT
+        payload), earlier chunks' are a one-row head by-product."""
+        tokens = np.asarray(tokens, dtype=np.int32)
+        c = int(tokens.shape[0])
+        bucket = self.bucket_for(c)
+        self._ensure_chunk(bucket)
+        toks = np.zeros((bucket,), np.int32)
+        toks[:c] = tokens
+        mpp = self.pages_per_stream
+        pt = np.full((mpp,), self.cache.trash, np.int32)
+        n = min(len(pages), mpp)
+        pt[:n] = pages[:n]
+        self.cache.k, self.cache.v, logits = self._chunk[bucket](
+            self.cache.k, self.cache.v, jnp.asarray(toks),
+            jnp.asarray(pt), jnp.int32(pos0), jnp.int32(c))
         return np.asarray(logits)
 
     def step(self, tokens, page_tables, ctx_lens):
@@ -417,6 +692,28 @@ class _DecodeMetrics(object):
         self.steps = child(reg.counter(
             'paddle_tpu_decode_steps_total',
             'batched decode steps executed', L))
+        self.prefix_hits = child(reg.counter(
+            'paddle_tpu_decode_prefix_hit_tokens_total',
+            'prompt tokens served from cached prefix pages (prefill '
+            'MACs skipped)', L))
+        self.prefix_misses = child(reg.counter(
+            'paddle_tpu_decode_prefix_miss_tokens_total',
+            'prompt tokens the prefill actually computed', L))
+        self.prefix_evicted = child(reg.counter(
+            'paddle_tpu_decode_prefix_evicted_tokens_total',
+            'cached tokens LRU-evicted from the prefix trie under '
+            'pool pressure', L))
+        self.prefill_chunks = child(reg.counter(
+            'paddle_tpu_decode_prefill_chunks_total',
+            'chunked-prefill dispatches scheduled between decode '
+            'steps', L))
+        self.preempted = child(reg.counter(
+            'paddle_tpu_decode_preempted_streams_total',
+            'streams requeued on page-pool exhaustion mid-decode '
+            '(recompute on readmission)', L))
+        self.cached_pages = child(reg.gauge(
+            'paddle_tpu_decode_prefix_cached_pages',
+            'KV pages currently held by the prefix trie', L))
 
     def close(self):
         for m in self._families:
@@ -441,6 +738,11 @@ class DecodeStream(object):
         self._slot = None
         self._pages = None
         self._ctx_len = 0         # cached positions
+        # chunked-path worker state
+        self._prefill_pos = None  # next uncomputed position, else None
+        self._prompt_eff = None   # prompt (+ generated, post-preempt)
+        self._owned = []          # pages the stream must free/donate
+        self._ref_nodes = []      # trie nodes held by reference
 
     @property
     def ttft_s(self):
@@ -480,9 +782,13 @@ class DecodeServer(object):
 
     def __init__(self, engine, static_batching=False, greedy=True,
                  warmup=True):
+        from ..flags import FLAGS
         self.engine = engine
         self.static = bool(static_batching)
         self.greedy = bool(greedy)
+        self._reserve = max(0, int(FLAGS.decode_page_reserve))
+        self._preempted = 0       # lock: guarded_by(_cv)
+        self._chunk_rr = 0        # round-robin cursor, worker-owned
         lock = threading.Lock()
         # one lock, one wait-set: submit/close wake the worker
         self._cv = _lkd.make_condition('DecodeServer._cv', lock)
@@ -510,9 +816,16 @@ class DecodeServer(object):
         prompt = np.asarray(prompt, dtype=np.int32)
         span = int(prompt.shape[0]) + int(max_new_tokens)
         if span > self.engine.max_seq:
-            raise ValueError("prompt+max_new %d exceeds max_seq %d"
-                             % (span, self.engine.max_seq))
-        self.engine.bucket_for(len(prompt))  # reject oversize early
+            raise PromptTooLongError(
+                "prompt+max_new %d exceeds max_seq %d"
+                % (span, self.engine.max_seq))
+        if not self.engine.chunked:
+            # monolithic prefill: a prompt above the top bucket would
+            # only surface as a worker-thread error mid-serve — fail
+            # fast HERE, in the submitting thread, typed.  The chunked
+            # path has no bucket ceiling (chunks cover any prompt up
+            # to max_seq, already checked above).
+            self.engine.bucket_for(len(prompt))
         with self._cv:
             if self._stopping:
                 raise RuntimeError("DecodeServer is closed")
@@ -547,9 +860,29 @@ class DecodeServer(object):
         self._m.close()
 
     def stats(self):
+        from ..transpiler.memory_model import prefix_cached_bytes
+        eng = self.engine
+        prefix = eng.prefix
+        cached = prefix.cached_pages if prefix is not None else 0
         with self._cv:
             active = sum(1 for s in self._slots if s is not None)
             return {
+                'prefix_cache': prefix is not None,
+                'chunked_prefill': eng.chunked,
+                'prefix_hit_tokens': int(self._m.prefix_hits.value),
+                'prefix_miss_tokens':
+                    int(self._m.prefix_misses.value),
+                'prefix_evicted_tokens':
+                    int(self._m.prefix_evicted.value),
+                'prefill_chunks': int(self._m.prefill_chunks.value),
+                'preempted': self._preempted,
+                'cached_pages': cached,
+                # shared pages are counted ONCE: they live inside the
+                # pool resident_bytes already reports — this is the
+                # trie-held subset an eviction sweep could reclaim
+                'prefix_cached_bytes': prefix_cached_bytes(
+                    cached, eng.page_size, eng.n_heads, eng.head_dim,
+                    eng.cache.k.dtype, n_layers=eng.n_layers),
                 'submitted': self._submitted,
                 'completed': self._completed,
                 'dropped': 0,  # admission queues, never sheds
@@ -579,6 +912,8 @@ class DecodeServer(object):
         the worker OUTSIDE the lock (device work); the slot itself was
         reserved under ``_cv`` by the loop."""
         eng = self.engine
+        if eng.chunked:
+            return self._admit_chunked(st)
         pages = eng.cache.alloc(self._pages_needed(st))
         if pages is None:
             return False
@@ -595,10 +930,188 @@ class DecodeServer(object):
         self._m.tokens.inc()
         return True
 
+    def _evict(self, want):
+        """LRU-evict up to ``want`` unreferenced trie pages back to the
+        pool free list (counted; referenced pages are untouchable)."""
+        eng = self.engine
+        freed = eng.prefix.evict(want)
+        if freed:
+            eng.cache.free(freed)
+            self._m.prefix_evicted.inc(len(freed) * eng.page_size)
+        return len(freed)
+
+    def _admit_chunked(self, st):
+        """Incremental admission: match the prompt against the prefix
+        trie (claiming cached pages by reference), then claim only the
+        pages the computed TAIL needs — and only while the pool keeps
+        ``reserve`` pages of headroom for running streams' growth.
+        Prefill itself is scheduled chunk-by-chunk in the loop."""
+        eng = self.engine
+        P, G = eng.page_size, eng.chunk_grid
+        if st._prompt_eff is None:
+            # preemption resume: the prompt grows the tokens already
+            # generated, so re-prefill recomputes the lost KV and its
+            # final chunk emits the NEXT token (greedy is
+            # deterministic — identical to the uninterrupted stream)
+            st._prompt_eff = np.concatenate(
+                [st.prompt, np.asarray(st.tokens, np.int32)]) \
+                if st.tokens else st.prompt
+        prompt = st._prompt_eff
+        t = len(prompt)
+        m, ref_pages, nodes = 0, [], []
+        if eng.prefix is not None and t > 0:
+            pages, nodes = eng.prefix.match(prompt)
+            # usable cached span: whole grid multiples only (so tail
+            # chunks are a suffix of the cold decomposition), capped
+            # at t-1 so prefill always computes >= 1 token — the
+            # last-position logits are the first generated token
+            m = (min(len(pages) * P, t - 1) // G) * G
+            keep = m // P
+            if keep < len(nodes):
+                eng.prefix.release(nodes[keep:])
+                nodes = nodes[:keep]
+            ref_pages = pages[:keep]
+        n_tail = -(-t // P) - m // P
+        short = n_tail + self._reserve - eng.cache.free_pages()
+        if short > 0 and eng.prefix is not None:
+            self._evict(short)
+        owned = None
+        if eng.cache.free_pages() >= n_tail + self._reserve:
+            owned = eng.cache.alloc(n_tail)
+        if owned is None:
+            if nodes:
+                eng.prefix.release(nodes)
+            return False
+        st._pages = list(ref_pages) + list(owned)
+        st._owned = list(owned)
+        st._ref_nodes = nodes
+        st._prefill_pos = m
+        self._m.pages_allocated.inc(len(owned))
+        self._m.prefix_hits.inc(m)
+        self._m.prefix_misses.inc(t - m)
+        return True
+
+    def _trie_insert(self, st, upto, acquire):
+        """Insert the stream's full pages covering positions
+        [0, upto) into the trie; adopted pages leave ``st._owned``
+        (the trie owns them now).  With ``acquire`` the stream swaps
+        its held refs for refs on the whole inserted path."""
+        eng = self.engine
+        seq = np.concatenate(
+            [st._prompt_eff, np.asarray(st.tokens, np.int32)])[:upto] \
+            if st.tokens else st._prompt_eff[:upto]
+        if acquire and st._ref_nodes:
+            eng.prefix.release(st._ref_nodes)
+        nodes, adopted = eng.prefix.insert(seq, st._pages,
+                                           acquire=acquire)
+        for i in adopted:
+            st._owned.remove(st._pages[i])
+        if acquire:
+            st._ref_nodes = nodes
+
+    def _finish_prefill(self, st, logits):
+        """The stream's final chunk ran: emit the first token and
+        publish its full prompt pages to the trie, so a stream
+        submitted RIGHT NOW — while this one decodes — already hits."""
+        eng = self.engine
+        first = int(np.argmax(logits))
+        now = time.perf_counter()
+        if st.first_token_t is None:
+            st.first_token_t = now
+            self._m.ttft.observe(st.ttft_s)
+        st.tokens.append(first)
+        st.token_times.append(now)
+        st._ctx_len = len(st._prompt_eff)
+        self._m.tokens.inc()
+        if eng.prefix is not None:
+            self._trie_insert(st, st._ctx_len, acquire=True)
+
+    def _run_prefill_chunks(self, active):
+        """Schedule prefill chunks under the per-tick token budget,
+        round-robin across streams so one long prompt cannot starve
+        another's TTFT.  Budget 0 = unlimited (whole prefill now)."""
+        eng = self.engine
+        budget = eng.chunk_tokens if eng.chunk_tokens > 0 else None
+        pending = [st for st in active if st._prefill_pos is not None]
+        if not pending:
+            return
+        rr = self._chunk_rr % len(pending)
+        self._chunk_rr += 1
+        used = 0
+        for st in pending[rr:] + pending[:rr]:
+            prompt = st._prompt_eff
+            t = len(prompt)
+            while st._prefill_pos is not None and \
+                    (budget is None or used < budget):
+                lo = st._prefill_pos
+                hi = min(lo + eng.chunk_grid, t)
+                logits = eng.prefill_chunk(prompt[lo:hi], st._pages,
+                                           lo)
+                self._m.prefill_chunks.inc()
+                used += hi - lo
+                if hi >= t:
+                    st._prefill_pos = None
+                    self._finish_prefill(st, logits)
+                else:
+                    st._prefill_pos = hi
+            if budget is not None and used >= budget:
+                break
+
+    def _ensure_capacity(self, st):
+        """Claim-as-context-grows: the next step writes position
+        ``ctx_len`` — claim its page if the stream has outgrown its
+        claim (evicting unreferenced cache pages first).  On true
+        exhaustion preempt: free everything, requeue FRONT, recompute
+        at readmission.  Returns False when preempted."""
+        eng = self.engine
+        if st._ctx_len // eng.page_size < len(st._pages):
+            return True
+        if eng.cache.free_pages() < 1 and eng.prefix is not None:
+            self._evict(1)
+        pages = eng.cache.alloc(1)
+        if pages is not None:
+            st._pages.extend(pages)
+            st._owned.extend(pages)
+            self._m.pages_allocated.inc(1)
+            return True
+        if st._ref_nodes:
+            eng.prefix.release(st._ref_nodes)
+            st._ref_nodes = []
+        if st._owned:
+            eng.cache.free(st._owned)
+            self._m.pages_freed.inc(len(st._owned))
+            st._owned = []
+        st._pages = None
+        st._prompt_eff = None
+        st._prefill_pos = None
+        st._ctx_len = 0
+        self._m.preempted.inc()
+        with self._cv:
+            self._preempted += 1
+            self._slots[st._slot] = None
+            st._slot = None
+            self._queue.appendleft(st)
+            self._m.queue_depth.set(len(self._queue))
+        return False
+
     def _retire(self, st):
         self._slots[st._slot] = None
-        self.engine.cache.free(st._pages)
-        self._m.pages_freed.inc(len(st._pages))
+        eng = self.engine
+        if eng.chunked:
+            if eng.prefix is not None and st._pages:
+                # donate the completed stream's full pages — prompt
+                # AND generated span — back to the trie (refs 0:
+                # instantly reusable, instantly evictable)
+                self._trie_insert(st, st._ctx_len, acquire=False)
+            if st._ref_nodes:
+                eng.prefix.release(st._ref_nodes)
+                st._ref_nodes = []
+            eng.cache.free(st._owned)
+            self._m.pages_freed.inc(len(st._owned))
+            st._owned = []
+        else:
+            eng.cache.free(st._pages)
+            self._m.pages_freed.inc(len(st._pages))
         st._pages = None
         st.done_t = time.perf_counter()
         self._completed += 1
@@ -646,11 +1159,27 @@ class DecodeServer(object):
                 self._m.streams_active.set(len(active))
             if not active:
                 continue
+            if eng.chunked:
+                # interleave: up to chunk_tokens of prefill work, then
+                # one decode step for every prefill-complete stream —
+                # a long prompt dents running streams' inter-token
+                # latency by one chunk, not one monolithic bucket
+                self._run_prefill_chunks(active)
+                decoding = [st for st in active
+                            if st._prefill_pos is None]
+                decoding = [st for st in decoding
+                            if self._ensure_capacity(st)]
+                if eng.prefix is not None:
+                    self._m.cached_pages.set(eng.prefix.cached_pages)
+            else:
+                decoding = active
+            if not decoding:
+                continue
             # build the batched step inputs from host stream state
             tokens = np.zeros((S,), np.int32)
             pts = np.full((S, mpp), trash, np.int32)
             ctx = np.zeros((S,), np.int32)
-            for st in active:
+            for st in decoding:
                 i = st._slot
                 tokens[i] = st.tokens[-1]
                 pts[i, :len(st._pages)] = st._pages
@@ -659,7 +1188,7 @@ class DecodeServer(object):
             now = time.perf_counter()
             self._m.steps.inc()
             finished = []
-            for st in active:
+            for st in decoding:
                 i = st._slot
                 st._ctx_len += 1
                 if len(st.tokens) < st.max_new_tokens:

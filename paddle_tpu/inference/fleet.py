@@ -155,7 +155,11 @@ def _decode_resident(server):
     paged KV pools plus the weight set.  Both live for the server's
     whole lifetime — unlike a batching replica's compiled buckets,
     nothing here is evictable, so the whole figure counts against the
-    fleet's HBM budget."""
+    fleet's HBM budget.  Prefix-cache sharing never inflates this: a
+    page referenced by N streams and the trie is one physical page of
+    the pool, so the pool closed form already counts it exactly once
+    (the server's stats()['prefix_cached_bytes'] names the trie-held
+    subset inside this figure, not on top of it)."""
     eng = server.engine
     return int(eng.resident_bytes()) + sum(
         int(v.nbytes) for v in eng.params.values())

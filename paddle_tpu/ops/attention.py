@@ -64,6 +64,57 @@ def paged_attention_math(q, k_pool, v_pool, page_table, ctx_len,
     return o.astype(q.dtype)
 
 
+def chunked_prefill_attention_math(q, k_pool, v_pool, page_table, pos0,
+                                   scale=None):
+    """Chunked-prefill attention for ONE stream against a partial page
+    table: chunk queries attend over every already-cached position —
+    prior chunks AND the chunk's own keys (scattered before the call) —
+    via the stream's page table.
+
+    ``q`` [C, H, D] — a prompt chunk whose query ``i`` sits at ABSOLUTE
+    position ``pos0 + i``; ``k_pool``/``v_pool`` [N, P, H, D] page
+    pools; ``page_table`` [MPP] int32 page ids for the stream (entries
+    past the claimed span may point anywhere — typically the trash
+    page — their keys are causally masked); ``pos0`` scalar int32.
+    Returns [C, H, D].  Key at absolute position ``j`` is valid for
+    query ``i`` iff ``j <= pos0 + i`` — the causal mask on the
+    absolute-position grid, so stale pages, trash entries, and the
+    chunk's padded tail all mask out.  f32 scores/softmax, identical
+    accumulation order to ``paged_attention_math``: a chunk sequence
+    over the same cached pages reproduces the prefix bitwise
+    (tests/test_decode_prefix.py pins hit-vs-cold equality).
+    """
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    n, p = k_pool.shape[0], k_pool.shape[1]
+    c, h, d = q.shape
+    mpp = page_table.shape[0]
+    idx = jnp.clip(page_table, 0, n - 1)
+    k = k_pool[idx].reshape(mpp * p, h, d)      # [T, H, D]
+    v = v_pool[idx].reshape(mpp * p, h, d)
+    scores = jnp.einsum('chd,thd->cht', q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    qpos = pos0 + jnp.arange(c)                  # absolute positions
+    valid = jnp.arange(mpp * p)[None, :] <= qpos[:, None]  # [C, T]
+    scores = jnp.where(valid[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum('cht,thd->chd', probs, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+@register_op('chunked_prefill_attention')
+def _chunked_prefill_attention(ctx, ins, attrs):
+    q = first(ins, 'Q')              # [C, H, D]
+    k_pool = first(ins, 'KPool')     # [N, P, H, D]
+    v_pool = first(ins, 'VPool')
+    page_table = first(ins, 'PT')    # [MPP] int32
+    pos0 = first(ins, 'Pos0')        # scalar int32
+    return out(chunked_prefill_attention_math(
+        q, k_pool, v_pool, page_table.astype(jnp.int32),
+        jnp.asarray(pos0, jnp.int32).reshape(()),
+        scale=attrs.get('scale', None)))
+
+
 @register_op('paged_attention')
 def _paged_attention(ctx, ins, attrs):
     q = first(ins, 'Q')              # [S, H, D]

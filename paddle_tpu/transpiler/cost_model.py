@@ -43,7 +43,8 @@ from ..core.registry import COST_MAC, cost_class, op_traits
 from . import passes
 
 __all__ = ['analyze_cost', 'op_cost', 'MAC_FORMULAS', 'BYTES_FORMULAS',
-           'WAIVED_OPS', 'FLOPS_BASIS', 'decode_step_cost']
+           'WAIVED_OPS', 'FLOPS_BASIS', 'decode_step_cost',
+           'prefill_cost']
 
 FLOPS_BASIS = ('FLOPs = 2 x MACs from closed-form per-op formulas '
                '(registry.COST_MAC); elementwise/reduction ops cost '
@@ -292,6 +293,28 @@ def _macs_paged_attention(ins, outs, attrs, unknown):
     return 2 * int(s) * int(h) * int(mpp) * int(p) * int(d)
 
 
+def _macs_chunked_prefill_attention(ins, outs, attrs, unknown):
+    # one stream's prompt chunk: C queries x the stream's gathered page
+    # span T = MPP * page_size, q·K^T + P·V — 2 * C*H*T*D MACs.  Like
+    # paged_attention the padded span is the compiled upper bound the
+    # executable actually runs.
+    q = _first(ins, 'Q')
+    kp = _first(ins, 'KPool')
+    pt = _first(ins, 'PT')
+    if q is None or kp is None or pt is None:
+        return None
+    if len(q[0]) != 3 or len(kp[0]) != 4 or len(pt[0]) != 1:
+        return None
+    c, h, d = q[0]
+    p = kp[0][1]
+    mpp = pt[0][0]
+    for v in (c, h, d, p, mpp):
+        if v is None or v < 0:
+            unknown[0] += 1
+            return None
+    return 2 * int(c) * int(h) * int(mpp) * int(p) * int(d)
+
+
 MAC_FORMULAS = {
     'mul': _macs_mul,
     'matmul': _macs_matmul,
@@ -309,6 +332,7 @@ MAC_FORMULAS = {
     'gru_unit': _macs_gru_unit,
     'flash_attention': _macs_flash_attention,
     'paged_attention': _macs_paged_attention,
+    'chunked_prefill_attention': _macs_chunked_prefill_attention,
     'fused_linear_softmax_ce': _macs_vocab_ce,
     'vocab_parallel_ce': _macs_vocab_ce,
 }
@@ -341,8 +365,32 @@ def _bytes_paged_attention(ins, outs, attrs, unknown):
 # partially touches — charging the whole resident buffer per step would
 # make the roofline position nonsense.  Same calling convention as
 # MAC_FORMULAS; None falls back to the generic tally.
+def _bytes_chunked_prefill_attention(ins, outs, attrs, unknown):
+    # single-stream chunk: reads the stream's MPP pages of K and V once,
+    # never the whole pool (same partial-touch argument as
+    # _bytes_paged_attention).
+    q = _first(ins, 'Q')
+    kp = _first(ins, 'KPool')
+    pt = _first(ins, 'PT')
+    p0 = _first(ins, 'Pos0')
+    o = _first(outs, 'Out')
+    if q is None or kp is None or pt is None:
+        return None
+    if len(kp[0]) != 4 or len(pt[0]) != 1:
+        return None
+    mpp = pt[0][0]
+    if mpp is None or mpp < 0:
+        unknown[0] += 1
+        return None
+    p, h, d = (int(x) for x in kp[0][1:])
+    kv = 2 * int(mpp) * p * h * d * _dtype_bytes(kp[1])
+    return (kv + _spec_bytes(q, unknown) + _spec_bytes(o, unknown)
+            + _spec_bytes(pt, unknown) + _spec_bytes(p0, unknown))
+
+
 BYTES_FORMULAS = {
     'paged_attention': _bytes_paged_attention,
+    'chunked_prefill_attention': _bytes_chunked_prefill_attention,
 }
 
 
@@ -367,6 +415,42 @@ def decode_step_cost(n_layers, d_model, n_heads, d_ff, vocab_size,
     # KV traffic: read the whole context per layer, write one position
     kv_bytes = n_layers * 2 * s * (t + 1) * d * dtype_bytes
     return {'flops': 2 * int(macs),
+            'bytes': int(param_bytes + kv_bytes),
+            'kv_bytes': int(kv_bytes)}
+
+
+def prefill_cost(n_layers, d_model, n_heads, d_ff, vocab_size,
+                 prompt_len, cached_len=0, dtype_bytes=4):
+    """Closed-form cost of ONE stream's prefill with ``cached_len``
+    prompt positions served from the prefix cache: only positions
+    [cached_len, prompt_len) run projections, and their causal
+    attention keys span the FULL prompt (cached K/V is read, not
+    recomputed).  ``flops_cached`` is what a cold run would have spent
+    on the cached span — the prefix-hit saving the shared-prefix bench
+    reports (cached + computed == the cached_len=0 total, exactly).
+    Exact triangular attention (sum of i+1 keys for query i), not the
+    padded-bucket upper bound the executables run."""
+    t, m = int(prompt_len), int(cached_len)
+    m = max(0, min(m, t))
+    d, f, v, h = int(d_model), int(d_ff), int(vocab_size), int(n_heads)
+    head_dim = d // max(h, 1)
+
+    def span_macs(lo, hi):
+        # projections for positions [lo, hi) + causal attention where
+        # query i attends i+1 keys: sum = (hi(hi+1) - lo(lo+1)) / 2
+        proj = (hi - lo) * (3 * d * d + d * d + d * f + f * d)
+        attn = 2 * h * head_dim * (hi * (hi + 1) - lo * (lo + 1)) // 2
+        return int(n_layers) * (proj + attn)
+
+    computed = span_macs(m, t) + d * v  # head: last position only
+    cached = span_macs(0, m)
+    # bytes: params once, KV written for computed positions, KV read
+    # for the cached prefix (decode-grade traffic, it is not free)
+    param_bytes = (int(n_layers) * (3 * d * d + d * d + d * f + f * d)
+                   + v * d) * dtype_bytes
+    kv_bytes = int(n_layers) * 2 * t * d * dtype_bytes
+    return {'flops': 2 * int(computed),
+            'flops_cached': 2 * int(cached),
             'bytes': int(param_bytes + kv_bytes),
             'kv_bytes': int(kv_bytes)}
 

@@ -57,7 +57,8 @@ Chrome trace counter track (``ph:"C"``) next to the measured
 from ..core import registry
 from . import cost_model as _cm
 
-__all__ = ['analyze_memory', 'page_pool_bytes', 'WAIVED_OPS']
+__all__ = ['analyze_memory', 'page_pool_bytes', 'prefix_cached_bytes',
+           'WAIVED_OPS']
 
 
 def page_pool_bytes(num_pages, page_size, num_heads, head_dim,
@@ -74,6 +75,20 @@ def page_pool_bytes(num_pages, page_size, num_heads, head_dim,
     itemsize = np.dtype(datatypes.as_numpy_dtype(dtype)).itemsize
     return (int(n_layers) * int(kv) * int(num_pages) * int(page_size)
             * int(num_heads) * int(head_dim) * int(itemsize))
+
+
+def prefix_cached_bytes(num_cached_pages, page_size, num_heads,
+                        head_dim, dtype='float32', n_layers=1):
+    """Bytes of pool residency currently HELD by the decode prefix
+    cache.  Cached pages live inside the engine's page pools — a page
+    referenced by three streams and the trie is ONE physical page, so
+    ``resident_bytes`` (the pool closed form above) already counts
+    every shared page exactly once and tenancy admission charges no
+    extra for sharing.  This sizes the trie-held subset only, for the
+    ``prefix_cached_bytes`` stats key: how much of the pool an eviction
+    sweep could reclaim at zero refs."""
+    return page_pool_bytes(num_cached_pages, page_size, num_heads,
+                           head_dim, dtype, n_layers=n_layers)
 
 # Ops with NO per-op live-bytes verdict — same data-dependent-extent
 # set the cost model waives (minus 'autodiff', which this model DOES

@@ -312,3 +312,24 @@ register_tunable(
     help='prompt-length bucket ladder top for prefill (powers of two '
          'up to this, clamped to the model context): taller ladders '
          'pad long prompts less but compile more variants at warmup')
+register_tunable(
+    'decode_prefix_cache', (False, True),
+    default=False, subsystem='inference.decode',
+    env='PADDLE_TPU_DECODE_PREFIX_CACHE',
+    help='radix-trie prefix reuse of KV pages: shared-prefix prompts '
+         'skip the cached span\'s prefill MACs at the price of trie '
+         'bookkeeping and chunked (per-grid) prefill dispatches')
+register_tunable(
+    'decode_prefill_chunk_tokens', (0, 32, 64, 128, 256),
+    default=0, subsystem='inference.decode',
+    env='PADDLE_TPU_DECODE_PREFILL_CHUNK_TOKENS',
+    help='per-tick chunked-prefill token budget: smaller bounds the '
+         'inter-token latency hit of a long-prompt admission, larger '
+         'finishes prefill (TTFT) sooner; 0 = whole prefill per tick')
+register_tunable(
+    'decode_page_reserve', (0, 1, 2, 4, 8),
+    default=2, subsystem='inference.decode',
+    env='PADDLE_TPU_DECODE_PAGE_RESERVE',
+    help='admission-time free-page watermark under incremental '
+         'allocation: higher admits later but preempts growing '
+         'streams less often when the pool runs tight')

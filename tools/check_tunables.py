@@ -41,6 +41,8 @@ _REQUIRED = (
     'flat_tile_budget', 'amp', 'mesh',
     'overlap', 'overlap_bucket_mb', 'pp_microbatches',
     'decode_page_size', 'decode_max_streams', 'decode_prefill_bucket',
+    'decode_prefix_cache', 'decode_prefill_chunk_tokens',
+    'decode_page_reserve',
 )
 
 
